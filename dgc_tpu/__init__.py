@@ -1,0 +1,36 @@
+"""dgc_tpu — a TPU-native Deep Gradient Compression training framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of the reference
+PyTorch/Horovod DGC system (Lin et al., ICLR 2018). The reference's hook-driven
+architecture (per-parameter autograd hooks launching async Horovod collectives)
+is re-designed as a single jitted, functional train step over an explicit state
+pytree, sharded with `jax.shard_map` over a `jax.sharding.Mesh`; the XLA
+latency-hiding scheduler overlaps compression+collectives with backward compute
+instead of Python-managed handles.
+
+The reference's plugin boundary survives as typed interfaces (see
+`dgc_tpu.compression.base.Compressor` and `dgc_tpu.compression.memory.Memory`):
+compressors expose compress/decompress/communicate, memories expose
+compensate/update, and the distributed optimizer is generic over both.
+"""
+
+__version__ = "0.1.0"
+
+from dgc_tpu.compression.dgc import DGCCompressor
+from dgc_tpu.compression.memory import Memory, DGCSGDMemory
+from dgc_tpu.compression.base import Compressor, NoneCompressor, FP16Compressor, Compression
+from dgc_tpu.optim.sgd import dgc_sgd, sgd
+from dgc_tpu.optim.distributed import DistributedOptimizer
+
+__all__ = [
+    "DGCCompressor",
+    "Memory",
+    "DGCSGDMemory",
+    "Compressor",
+    "NoneCompressor",
+    "FP16Compressor",
+    "Compression",
+    "dgc_sgd",
+    "sgd",
+    "DistributedOptimizer",
+]

@@ -1,0 +1,22 @@
+from dgc_tpu.compression.base import (
+    Compression,
+    Compressor,
+    CompressCtx,
+    FP16Compressor,
+    NoneCompressor,
+)
+from dgc_tpu.compression.dgc import DGCCompressor, TensorAttrs, sampling_geometry
+from dgc_tpu.compression.memory import DGCSGDMemory, Memory
+
+__all__ = [
+    "Compression",
+    "Compressor",
+    "CompressCtx",
+    "FP16Compressor",
+    "NoneCompressor",
+    "DGCCompressor",
+    "TensorAttrs",
+    "sampling_geometry",
+    "DGCSGDMemory",
+    "Memory",
+]

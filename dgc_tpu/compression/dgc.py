@@ -1,0 +1,282 @@
+"""DGCCompressor — sampled-top-k gradient sparsification, TPU-native.
+
+Re-implements the algorithm contract of the reference compressor
+(/root/reference/dgc/compression.py) with static shapes so the whole train
+step compiles to one XLA program:
+
+* per-tensor attributes (sampling geometry) are computed host-side at
+  ``initialize`` time — they depend only on shapes and the compress ratio
+  (reference compression.py:56-89, SURVEY.md §2.1);
+* ``_sparsify``'s variable-length ``nonzero`` becomes a fixed-size top-k
+  selection with a validity mask (see ``dgc_tpu.ops.sparsify``);
+* the wire format is a pair ``(values[num_selects], indices[num_selects])``
+  per tensor, padded — XLA ``all_gather`` needs uniform shapes where MPI
+  allgatherv tolerated ragged ones (SURVEY.md §5, the key semantic delta);
+* decompress is scatter-add of all workers' payloads then average
+  (reference compression.py:179-194, SURVEY.md §2.5);
+* the epoch-wise warm-up compress-ratio schedule re-runs ``initialize``; a
+  ratio change means new static attributes and therefore a re-jit of the step
+  (bounded: ≤ warmup_epochs + 1 distinct programs).
+"""
+
+import math
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dgc_tpu.compression.base import CompressCtx, Compressor
+from dgc_tpu.compression.memory import Memory
+from dgc_tpu.ops import sparsify as ops
+
+__all__ = ["DGCCompressor", "TensorAttrs", "sampling_geometry"]
+
+
+class TensorAttrs(NamedTuple):
+    """Static per-tensor sparsification geometry (compression.py:85)."""
+    numel: int
+    shape: Tuple[int, ...]
+    num_selects: int
+    num_samples: int
+    top_k_samples: int
+    sample_stride: int
+
+
+def sampling_geometry(numel: int, sample_ratio: float,
+                      compress_ratio: float) -> Tuple[int, int]:
+    """(num_samples, sample_stride) per the reference recipe
+    (compression.py:66-82, SURVEY.md §2.1).
+
+    The stride starts at ``ceil(numel / max(pct, cpr) / 32)*32 + 1`` (32-aligned
+    +1 so strided samples sweep misaligned phases) and backs off by 8 until at
+    least ``max(pct_numel, cpr_numel)`` samples fit.
+    """
+    if sample_ratio >= 1.0:
+        return numel, 1
+    pct_numel = int(math.ceil(numel * sample_ratio))
+    cpr_numel = int(math.ceil(2 / compress_ratio))
+    if numel <= cpr_numel:
+        # tiny-tensor degenerate path: sample everything, transmit ~1 element
+        return numel, 1
+    sample_stride = int(math.ceil(numel / max(pct_numel, cpr_numel) / 32)) * 32 + 1
+    num_samples = numel // sample_stride
+    # stride is 32k+1 ≡ 1 (mod 8); backing off by 8 bottoms out at stride 1
+    while num_samples < max(pct_numel, cpr_numel) and sample_stride > 8:
+        sample_stride -= 8
+        num_samples = numel // sample_stride
+    return num_samples, sample_stride
+
+
+class DGCCompressor(Compressor):
+    """Deep Gradient Compression: momentum-corrected sampled-top-k
+    sparsification with adaptive thresholding and warm-up schedule
+    (reference compression.py:17-212)."""
+
+    def __init__(self, compress_ratio, memory: Memory = None,
+                 sample_ratio: float = 0.01, strided_sample: bool = True,
+                 compress_upper_bound: float = 1.3,
+                 compress_lower_bound: float = 0.8,
+                 max_adaptation_iters: int = 10, resample: bool = True,
+                 fp16_values: bool = False, int32_indices: bool = True,
+                 warmup_epochs: int = -1, warmup_coeff=None,
+                 verbose: bool = False):
+        self.fp16_values = fp16_values
+        # Indices are int32 natively on TPU (XLA default; int64 requires x64
+        # mode and doubles wire traffic). The flag is kept for config parity
+        # with the reference (compression.py:26) but int32 is always used.
+        self.int32_indices = int32_indices
+
+        self.base_compress_ratio = self.compress_ratio = (
+            compress_ratio if compress_ratio <= 1.0 else 1.0 / compress_ratio)
+        self.memory = Memory() if memory is None else memory
+        self.warmup_epochs = warmup_epochs
+        if self.warmup_epochs > 0:
+            if warmup_coeff is None:
+                self.warmup_coeff = self.base_compress_ratio ** (
+                    1.0 / (self.warmup_epochs + 1))
+            else:
+                if isinstance(warmup_coeff, (tuple, list)):
+                    assert len(warmup_coeff) >= self.warmup_epochs
+                    for wc in warmup_coeff:
+                        assert 0 < wc <= 1
+                else:
+                    assert 0 < warmup_coeff <= 1
+                self.warmup_coeff = warmup_coeff
+        else:
+            self.warmup_coeff = 1
+
+        self.sample_ratio = min(max(sample_ratio, 0.01), 1.0)
+        self.strided_sample = strided_sample
+        self.compress_upper_bound = compress_upper_bound
+        self.compress_lower_bound = compress_lower_bound
+        self.max_adaptation_iters = max_adaptation_iters
+        self.resample = resample
+        self.verbose = verbose
+
+        self.attributes: Dict[str, TensorAttrs] = {}
+
+    # ------------------------------------------------------------------ #
+    # host-side setup                                                    #
+    # ------------------------------------------------------------------ #
+
+    def initialize(self, named_params) -> None:
+        """Precompute static attrs for every compressible tensor.
+
+        ``named_params`` yields (name, array) or (name, TensorAttrs) — the
+        latter form supports re-initialization on ratio change (the reference
+        re-feeds ``self.attributes.items()``, compression.py:107).
+        """
+        if self.verbose:
+            print("=> initializing dgc compressor")
+        for name, param in named_params:
+            if isinstance(param, TensorAttrs):
+                numel, shape = param.numel, param.shape
+            elif hasattr(param, "shape"):
+                numel, shape = int(param.size), tuple(param.shape)
+            else:
+                numel, shape = param
+                shape = tuple(shape)
+            num_samples, sample_stride = sampling_geometry(
+                numel, self.sample_ratio, self.compress_ratio)
+            top_k_samples = int(math.ceil(num_samples * self.compress_ratio))
+            num_selects = int(math.ceil(numel * self.compress_ratio))
+            self.attributes[name] = TensorAttrs(
+                numel=numel, shape=shape, num_selects=num_selects,
+                num_samples=num_samples, top_k_samples=top_k_samples,
+                sample_stride=sample_stride)
+            if self.verbose:
+                print(f"   {name:<40}: transmit {num_selects} / {numel} "
+                      f"(threshold {top_k_samples} / {num_samples} samples "
+                      f"at stride {sample_stride})")
+
+    def warmup_compress_ratio(self, epoch: int) -> bool:
+        """Epoch hook (reference compression.py:91-107). Returns True when the
+        ratio changed — the caller must then rebuild/re-jit the train step
+        (static attrs changed)."""
+        if self.warmup_epochs > 0:
+            if epoch < self.warmup_epochs:
+                if isinstance(self.warmup_coeff, (tuple, list)):
+                    compress_ratio = self.warmup_coeff[epoch]
+                else:
+                    compress_ratio = max(self.warmup_coeff ** (epoch + 1),
+                                         self.base_compress_ratio)
+            else:
+                compress_ratio = self.base_compress_ratio
+        else:
+            compress_ratio = self.base_compress_ratio
+        if compress_ratio != self.compress_ratio:
+            self.compress_ratio = compress_ratio
+            self.initialize(list(self.attributes.items()))
+            return True
+        return False
+
+    # ------------------------------------------------------------------ #
+    # traced (pure) pieces                                               #
+    # ------------------------------------------------------------------ #
+
+    def sparsify(self, grad: jax.Array, name: str, key: jax.Array):
+        """Fixed-size sampled-top-k sparsification (compression.py:109-153,
+        SURVEY.md §2.2). Returns (values, indices, valid)."""
+        attrs = self.attributes[name]
+        flat = grad.reshape(-1)
+        importance = jnp.abs(flat)
+
+        if attrs.numel == attrs.num_samples:
+            samples = importance
+        elif self.strided_sample:
+            samples = ops.strided_sample(importance, attrs.num_samples,
+                                         attrs.sample_stride, key)
+        else:
+            samples = ops.uniform_sample(importance, attrs.num_samples, key)
+
+        threshold = ops.topk_threshold(samples, attrs.top_k_samples)
+        if attrs.numel > attrs.num_samples:
+            threshold = ops.adapt_threshold(
+                importance, threshold, attrs.num_selects,
+                self.compress_lower_bound, self.compress_upper_bound,
+                self.max_adaptation_iters, self.resample)
+        return ops.select_by_threshold(flat, importance, threshold,
+                                       attrs.num_selects)
+
+    def compress(self, mem_state, name, grad, key):
+        """Momentum-corrected sparsification (compression.py:155-177)."""
+        if self.compress_ratio < 1.0 and name in self.attributes:
+            attrs = self.attributes[name]
+            compensated, mem_state = self.memory.compensate(
+                mem_state, name, grad, accumulate=True)
+            values, indices, valid = self.sparsify(compensated, name, key)
+            mem_state = self.memory.update(mem_state, name, indices, valid)
+            ctx = CompressCtx(name=name, numel=attrs.numel, shape=attrs.shape,
+                              dtype=grad.dtype, compressed=True)
+            if self.fp16_values and jnp.issubdtype(values.dtype, jnp.floating):
+                values = values.astype(jnp.float16)
+            return (values, indices), ctx, mem_state
+        else:
+            ctx = CompressCtx(name=name, numel=grad.size, shape=grad.shape,
+                              dtype=grad.dtype, compressed=False)
+            payload = grad
+            if self.fp16_values and jnp.issubdtype(grad.dtype, jnp.floating):
+                payload = grad.astype(jnp.float16)
+            return payload, ctx, mem_state
+
+    def communicate(self, payload, ctx: CompressCtx, axis_name: str,
+                    world_size: int):
+        """The collective (compression.py:200-206): all_gather of
+        (values, indices) for sparse payloads, psum for dense fallback."""
+        if ctx.compressed:
+            values, indices = payload
+            return (jax.lax.all_gather(values, axis_name),
+                    jax.lax.all_gather(indices, axis_name))
+        return jax.lax.psum(payload, axis_name)
+
+    def exchange_fused(self, compressed, axis_name: str, world_size: int,
+                       mem_state):
+        """Fused exchange of many sparse payloads with exactly two collectives.
+
+        ``compressed`` maps name -> ((values, indices), ctx) for tensors this
+        compressor marked ``ctx.compressed``. All payloads are concatenated so
+        one ``all_gather`` moves every value and one moves every index —
+        the TPU answer to the reference's per-tensor named-handle fusion and
+        its stated thresholding/volume overhead caveats (README.md:130-138).
+        Exposed as an optional capability the distributed optimizer discovers
+        by duck typing, like the reference optimizer's
+        ``communicate``/``synchronize`` dispatch (optimizer.py:39-40).
+        """
+        names = list(compressed)
+        sizes = [compressed[n][0][0].shape[0] for n in names]
+        all_values = jnp.concatenate([compressed[n][0][0] for n in names])
+        all_indices = jnp.concatenate([compressed[n][0][1] for n in names])
+        g_values = jax.lax.all_gather(all_values, axis_name)
+        g_indices = jax.lax.all_gather(all_indices, axis_name)
+        out = {}
+        offset = 0
+        for n, sz in zip(names, sizes):
+            ctx = compressed[n][1]
+            piece = (g_values[:, offset:offset + sz],
+                     g_indices[:, offset:offset + sz])
+            out[n], mem_state = self.decompress(piece, ctx, mem_state,
+                                                world_size)
+            offset += sz
+        return out, mem_state
+
+    def decompress(self, gathered, ctx: CompressCtx, mem_state,
+                   world_size: int):
+        """Scatter-add all workers' payloads then average
+        (compression.py:179-198, SURVEY.md §2.5). Dense fallback averages then
+        applies non-accumulating momentum correction."""
+        if ctx.compressed:
+            values, indices = gathered          # [W, num_selects] each
+            if self.fp16_values:
+                values = values.astype(ctx.dtype)
+            dense = ops.scatter_add_dense(ctx.numel, indices, values,
+                                          dtype=ctx.dtype)
+            dense = dense / world_size          # hvd.Average semantics
+            return dense.reshape(ctx.shape), mem_state
+        else:
+            grad = gathered
+            if self.fp16_values and jnp.issubdtype(grad.dtype, jnp.floating):
+                grad = grad.astype(ctx.dtype)
+            grad = (grad / world_size).astype(ctx.dtype)
+            out, mem_state = self.memory.compensate(
+                mem_state, ctx.name, grad, accumulate=False)
+            return out.reshape(ctx.shape), mem_state

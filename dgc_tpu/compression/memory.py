@@ -1,0 +1,137 @@
+"""Error-feedback memory for DGC momentum correction.
+
+TPU-native re-design of the reference's memory objects
+(/root/reference/dgc/memory.py:9-88): instead of a stateful object mutating
+per-parameter torch buffers in place, memory *state* is an explicit pytree
+``{'momentums': {name: 1-D array}, 'velocities': {name: 1-D array}}`` threaded
+through the jitted train step, and the ``Memory`` classes hold only static
+configuration plus pure functions over that state.
+
+The algorithm contract (SURVEY.md §2.3-2.4):
+
+* ``compensate(accumulate=True)`` — momentum correction + local accumulation:
+  ``mmt = m·mmt + g; vec += mmt`` (nesterov: ``mmt = (mmt+g)·m; vec += mmt+g``),
+  returns the velocity (the compensated gradient to sparsify).
+* ``compensate(accumulate=False)`` — dense-fallback path (used after the dense
+  average, reference compression.py:198): updates the momentum only and returns
+  the momentum-corrected gradient; velocities untouched.
+* ``update`` — after transmission, zero ``velocities`` at transmitted
+  coordinates always, and ``momentums`` there only when ``momentum_masking``.
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+from dgc_tpu.ops.sparsify import transmitted_mask
+
+__all__ = ["Memory", "DGCSGDMemory"]
+
+
+class Memory:
+    """No-op base memory (reference memory.py:9-28): the identity plugin."""
+
+    def init(self, named_params) -> Dict:
+        return {}
+
+    def compensate(self, state: Dict, name: str, grad, accumulate: bool = True):
+        return grad, state
+
+    def update(self, state: Dict, name: str, indices, valid) -> Dict:
+        return state
+
+    # Checkpoint protocol parity (reference memory.py:22-28): state *is* the
+    # checkpointable object in the functional design.
+    def state_dict(self, state: Dict):
+        return None
+
+    def load_state_dict(self, state: Dict, saved) -> Dict:
+        return state
+
+
+class DGCSGDMemory(Memory):
+    """Momentum-correction memory for DGC with an SGD-momentum base optimizer.
+
+    Mirrors reference ``DGCSGDMemory`` (memory.py:31-88). ``gradient_clipping``
+    is an optional pure function ``grad -> grad`` applied before correction
+    (pluggable, see ``dgc_tpu.utils.clip_grad``).
+    """
+
+    def __init__(self, momentum: float = 0.9, nesterov: bool = False,
+                 gradient_clipping: Optional[Callable] = None,
+                 momentum_masking: bool = True):
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.gradient_clipping = gradient_clipping
+        self.momentum_masking = momentum_masking
+
+    def init(self, named_params) -> Dict:
+        """Zero (momentum, velocity) buffers for every named parameter,
+        flattened to 1-D (reference memory.py:43-48)."""
+        momentums, velocities = {}, {}
+        for name, p in named_params:
+            momentums[name] = jnp.zeros((p.size,), p.dtype)
+            velocities[name] = jnp.zeros((p.size,), p.dtype)
+        return {"momentums": momentums, "velocities": velocities}
+
+    def compensate(self, state: Dict, name: str, grad, accumulate: bool = True):
+        grad = grad.reshape(-1)
+        if self.gradient_clipping is not None:
+            grad = self.gradient_clipping(grad)
+        m = self.momentum
+        mmt = state["momentums"][name]
+        if accumulate:
+            vec = state["velocities"][name]
+            if self.nesterov:
+                mmt = (mmt + grad) * m
+                vec = vec + mmt + grad
+            else:
+                mmt = m * mmt + grad
+                vec = vec + mmt
+            new_state = {
+                "momentums": {**state["momentums"], name: mmt},
+                "velocities": {**state["velocities"], name: vec},
+            }
+            return vec, new_state
+        else:
+            if self.nesterov:
+                mmt = (mmt + grad) * m
+                out = mmt + grad
+            else:
+                mmt = m * mmt + grad
+                out = mmt
+            new_state = {
+                "momentums": {**state["momentums"], name: mmt},
+                "velocities": state["velocities"],
+            }
+            return out, new_state
+
+    def update(self, state: Dict, name: str, indices, valid) -> Dict:
+        """Zero transmitted coordinates (reference memory.py:72-77), guarding
+        padded index-0 slots via the validity mask."""
+        numel = state["velocities"][name].shape[0]
+        sent = transmitted_mask(numel, indices, valid)
+        zeros = jnp.zeros((), state["velocities"][name].dtype)
+        velocities = {**state["velocities"],
+                      name: jnp.where(sent, zeros, state["velocities"][name])}
+        if self.momentum_masking:
+            momentums = {**state["momentums"],
+                         name: jnp.where(sent, zeros, state["momentums"][name])}
+        else:
+            momentums = state["momentums"]
+        return {"momentums": momentums, "velocities": velocities}
+
+    def state_dict(self, state: Dict):
+        return state
+
+    def load_state_dict(self, state: Dict, saved) -> Dict:
+        """Merge saved buffers by name (reference memory.py:82-88)."""
+        if saved is None:
+            return state
+        momentums = dict(state["momentums"])
+        velocities = dict(state["velocities"])
+        for name in momentums:
+            if name in saved["momentums"]:
+                momentums[name] = saved["momentums"][name]
+                velocities[name] = saved["velocities"][name]
+        return {"momentums": momentums, "velocities": velocities}

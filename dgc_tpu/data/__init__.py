@@ -1,0 +1,11 @@
+from dgc_tpu.data.datasets import (
+    CIFAR,
+    ImageNet,
+    Synthetic,
+    ArraySplit,
+    SyntheticSplit,
+)
+from dgc_tpu.data.sampler import epoch_batches, num_steps_per_epoch
+
+__all__ = ["CIFAR", "ImageNet", "Synthetic", "ArraySplit", "SyntheticSplit",
+           "epoch_batches", "num_steps_per_epoch"]

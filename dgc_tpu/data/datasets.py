@@ -1,0 +1,242 @@
+"""Datasets: CIFAR-10/100, ImageNet (folder), and synthetic stand-ins.
+
+Parity targets: ``torchpack.mtpack.datasets.vision.{CIFAR, ImageNet}``
+(referenced at /root/reference/configs/cifar/__init__.py:3 and
+configs/imagenet/__init__.py:3). A dataset is a dict-like of splits
+('train', 'test'); each split exposes ``__len__`` and
+``get_batch(indices) -> (images f32 NHWC, labels i32)`` with the split's
+transform (augment+normalize for train, normalize for eval) applied.
+
+Everything is numpy host-side; batches stream to the device already collated.
+CIFAR reads the standard python pickle batches directly (no torchvision in
+this environment); ImageNet scans a class-per-directory tree and decodes with
+PIL. Both fall back to a deterministic synthetic split when the data root is
+missing and ``synthetic_fallback`` is set — keeping smoke tests and benches
+runnable on machines without the datasets.
+"""
+
+import os
+import pickle
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ArraySplit", "SyntheticSplit", "CIFAR", "ImageNet", "Synthetic",
+           "CIFAR_MEAN", "CIFAR_STD", "IMAGENET_MEAN", "IMAGENET_STD"]
+
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _normalize(images_u8: np.ndarray, mean: np.ndarray,
+               std: np.ndarray) -> np.ndarray:
+    return (images_u8.astype(np.float32) / 255.0 - mean) / std
+
+
+def _random_crop_flip(images_u8: np.ndarray, pad: int,
+                      rng: np.random.RandomState) -> np.ndarray:
+    """Standard CIFAR augmentation: reflect-free zero-pad + random crop +
+    horizontal flip."""
+    n, h, w, c = images_u8.shape
+    padded = np.zeros((n, h + 2 * pad, w + 2 * pad, c), images_u8.dtype)
+    padded[:, pad:pad + h, pad:pad + w] = images_u8
+    out = np.empty_like(images_u8)
+    ys = rng.randint(0, 2 * pad + 1, size=n)
+    xs = rng.randint(0, 2 * pad + 1, size=n)
+    flips = rng.randint(0, 2, size=n).astype(bool)
+    for i in range(n):
+        img = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+        out[i] = img[:, ::-1] if flips[i] else img
+    return out
+
+
+class ArraySplit:
+    """In-memory split over uint8 NHWC images."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 mean: np.ndarray, std: np.ndarray, train: bool,
+                 pad: int = 4, augment: bool = True, seed: int = 0):
+        self.images = images
+        self.labels = labels.astype(np.int32)
+        self.mean = mean
+        self.std = std
+        self.train = train
+        self.pad = pad
+        self.augment = augment
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def get_batch(self, indices: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        imgs = self.images[indices]
+        if self.train and self.augment:
+            imgs = _random_crop_flip(imgs, self.pad, self._rng)
+        return _normalize(imgs, self.mean, self.std), self.labels[indices]
+
+
+class SyntheticSplit:
+    """Deterministic random data shaped like the real thing — for tests and
+    machine-local benches (no dataset download in this environment)."""
+
+    def __init__(self, n: int, image_size: int, num_classes: int,
+                 mean: np.ndarray, std: np.ndarray, seed: int = 0,
+                 train: bool = True):
+        rng = np.random.RandomState(seed)
+        self.images = rng.randint(0, 256, (n, image_size, image_size, 3),
+                                  dtype=np.uint8)
+        # labels correlated with pixel statistics so learning is possible
+        self.labels = (self.images.reshape(n, -1).astype(np.int64).sum(1)
+                       % num_classes).astype(np.int32)
+        self.mean, self.std = mean, std
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def get_batch(self, indices: np.ndarray):
+        return (_normalize(self.images[indices], self.mean, self.std),
+                self.labels[indices])
+
+
+def CIFAR(root: str, num_classes: int = 10, image_size: int = 32,
+          synthetic_fallback: bool = True, synthetic_size: int = 2048,
+          seed: int = 0) -> Dict[str, object]:
+    """CIFAR-10/100 from the standard python pickle batches."""
+    name = "cifar-10-batches-py" if num_classes == 10 else "cifar-100-python"
+    base = os.path.join(root, name)
+    if not os.path.isdir(base):
+        if os.path.isdir(root) and any(
+                f.startswith("data_batch") for f in os.listdir(root)):
+            base = root
+        elif synthetic_fallback:
+            return Synthetic(num_classes=num_classes, image_size=image_size,
+                             n_train=synthetic_size,
+                             n_test=max(synthetic_size // 4, 256),
+                             mean=CIFAR_MEAN, std=CIFAR_STD, seed=seed)
+        else:
+            raise FileNotFoundError(f"CIFAR data not found under {root}")
+
+    def load(files: Sequence[str]):
+        xs, ys = [], []
+        for f in files:
+            with open(os.path.join(base, f), "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.append(d.get(b"labels", d.get(b"fine_labels")))
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        y = np.concatenate([np.asarray(y) for y in ys])
+        return np.ascontiguousarray(x), y
+
+    if num_classes == 10:
+        train_x, train_y = load([f"data_batch_{i}" for i in range(1, 6)])
+        test_x, test_y = load(["test_batch"])
+    else:
+        train_x, train_y = load(["train"])
+        test_x, test_y = load(["test"])
+
+    return {
+        "train": ArraySplit(train_x, train_y, CIFAR_MEAN, CIFAR_STD,
+                            train=True, seed=seed),
+        "test": ArraySplit(test_x, test_y, CIFAR_MEAN, CIFAR_STD,
+                           train=False),
+    }
+
+
+class _ImageFolderSplit:
+    """Class-per-directory ImageNet split decoded with PIL on demand."""
+
+    def __init__(self, root: str, image_size: int, train: bool,
+                 seed: int = 0):
+        from PIL import Image  # noqa: F401 — fail fast if PIL missing
+        self.root = root
+        self.image_size = image_size
+        self.train = train
+        self._rng = np.random.RandomState(seed)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                self.samples.append((os.path.join(cdir, f),
+                                     self.class_to_idx[c]))
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def _load(self, path: str) -> np.ndarray:
+        from PIL import Image
+        img = Image.open(path).convert("RGB")
+        s = self.image_size
+        if self.train:
+            # RandomResizedCrop-style: random scale/aspect crop then resize
+            w, h = img.size
+            area = w * h
+            for _ in range(10):
+                target = self._rng.uniform(0.08, 1.0) * area
+                ar = np.exp(self._rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+                cw = int(round(np.sqrt(target * ar)))
+                ch = int(round(np.sqrt(target / ar)))
+                if cw <= w and ch <= h:
+                    x = self._rng.randint(0, w - cw + 1)
+                    y = self._rng.randint(0, h - ch + 1)
+                    img = img.crop((x, y, x + cw, y + ch)).resize((s, s))
+                    break
+            else:
+                img = img.resize((s, s))
+            arr = np.asarray(img, np.uint8)
+            if self._rng.randint(2):
+                arr = arr[:, ::-1]
+        else:
+            # resize shorter side to 1.143*s then center crop (256/224 recipe)
+            w, h = img.size
+            short = int(s * 256 / 224)
+            if w < h:
+                img = img.resize((short, int(h * short / w)))
+            else:
+                img = img.resize((int(w * short / h), short))
+            w, h = img.size
+            x, y = (w - s) // 2, (h - s) // 2
+            img = img.crop((x, y, x + s, y + s))
+            arr = np.asarray(img, np.uint8)
+        return arr
+
+    def get_batch(self, indices: np.ndarray):
+        imgs = np.stack([self._load(self.samples[i][0]) for i in indices])
+        labels = np.asarray([self.samples[i][1] for i in indices], np.int32)
+        return _normalize(imgs, IMAGENET_MEAN, IMAGENET_STD), labels
+
+
+def ImageNet(root: str, num_classes: int = 1000, image_size: int = 224,
+             synthetic_fallback: bool = True, synthetic_size: int = 512,
+             seed: int = 0) -> Dict[str, object]:
+    train_dir = os.path.join(root, "train")
+    val_dir = os.path.join(root, "val")
+    if not (os.path.isdir(train_dir) and os.path.isdir(val_dir)):
+        if synthetic_fallback:
+            return Synthetic(num_classes=num_classes, image_size=image_size,
+                             n_train=synthetic_size,
+                             n_test=max(synthetic_size // 4, 64),
+                             mean=IMAGENET_MEAN, std=IMAGENET_STD, seed=seed)
+        raise FileNotFoundError(f"ImageNet train/val not found under {root}")
+    return {
+        "train": _ImageFolderSplit(train_dir, image_size, train=True,
+                                   seed=seed),
+        "test": _ImageFolderSplit(val_dir, image_size, train=False),
+    }
+
+
+def Synthetic(num_classes: int = 10, image_size: int = 32,
+              n_train: int = 2048, n_test: int = 512,
+              mean: np.ndarray = CIFAR_MEAN, std: np.ndarray = CIFAR_STD,
+              seed: int = 0) -> Dict[str, object]:
+    return {
+        "train": SyntheticSplit(n_train, image_size, num_classes, mean, std,
+                                seed=seed, train=True),
+        "test": SyntheticSplit(n_test, image_size, num_classes, mean, std,
+                               seed=seed + 1, train=False),
+    }

@@ -1,0 +1,49 @@
+"""Distributed sampling — the TPU-host equivalent of
+``torch.utils.data.distributed.DistributedSampler`` (reference train.py:99-100).
+
+Semantics replicated: per-epoch deterministic shuffle seeded by
+``seed + epoch``, padding (by wrap-around duplication) so every worker sees
+the same number of samples, disjoint worker shards. The reference interleaves
+(rank gets ``indices[rank::world]``); here each worker takes a contiguous
+block of the shuffled order — the same distribution, but the host can hand
+the device one contiguous global batch whose leading axis shards over the
+mesh without a gather.
+"""
+
+from typing import Iterator, List
+
+import numpy as np
+
+__all__ = ["epoch_batches"]
+
+
+def epoch_batches(n: int, global_batch: int, epoch: int, seed: int = 0,
+                  shuffle: bool = True, drop_last: bool = False
+                  ) -> Iterator[np.ndarray]:
+    """Yield index arrays of exactly ``global_batch`` per step.
+
+    The last partial batch is wrap-padded (DistributedSampler pads to a
+    divisible total; the reference's padded duplicates are evaluated/trained
+    on too) unless ``drop_last`` (the reference drops last when
+    ``num_batches_per_step > 1``, train.py:105-106).
+    """
+    rng = np.random.RandomState(seed + epoch)
+    order = rng.permutation(n) if shuffle else np.arange(n)
+    n_full = n // global_batch
+    for b in range(n_full):
+        yield order[b * global_batch:(b + 1) * global_batch]
+    rem = n - n_full * global_batch
+    if rem and not drop_last:
+        tail = order[n_full * global_batch:]
+        # wrap-pad (tiling as needed when n < global_batch) to a full batch
+        reps = -(-(global_batch - rem) // n)
+        pad = np.tile(order, reps)[:global_batch - rem]
+        yield np.concatenate([tail, pad])
+
+
+def num_steps_per_epoch(n: int, global_batch: int,
+                        drop_last: bool = False) -> int:
+    full = n // global_batch
+    if not drop_last and n % global_batch:
+        full += 1
+    return full

@@ -1,0 +1,6 @@
+from dgc_tpu.models.resnet_cifar import CifarResNet, resnet20, resnet110
+from dgc_tpu.models.resnet_imagenet import ResNet, resnet18, resnet50
+from dgc_tpu.models.vgg import VGG, vgg16_bn
+
+__all__ = ["CifarResNet", "resnet20", "resnet110",
+           "ResNet", "resnet18", "resnet50", "VGG", "vgg16_bn"]

@@ -1,0 +1,85 @@
+"""CIFAR ResNets (ResNet-20 / ResNet-110) in flax.linen, NHWC.
+
+Parity targets: the reference harness builds these from mini-torchpack
+(`torchpack.mtpack.models.vision.resnet.{resnet20, resnet110}`, referenced at
+/root/reference/configs/cifar/resnet20.py:1 and resnet110.py:1) — the standard
+CIFAR ResNet family of He et al. (2016): a 3×3/16 stem, three stages of n
+basic blocks at 16/32/64 channels (depth = 6n+2), stride-2 at stage
+transitions, global average pool, linear classifier. Shortcuts use 1×1
+projection when the shape changes (option B).
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), BatchNorm with
+torch-matching hyperparameters (momentum 0.9 ≡ torch 0.1, eps 1e-5),
+kaiming-normal (fan_out) conv init matching torchvision's recipe.
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["CifarResNet", "resnet20", "resnet110"]
+
+conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class BasicBlock(nn.Module):
+    channels: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
+                       dtype=self.dtype)
+
+        residual = x
+        y = conv(self.channels, (3, 3), strides=(self.stride, self.stride),
+                 padding=1)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.channels, (3, 3), padding=1)(y)
+        y = norm()(y)
+
+        if residual.shape != y.shape:
+            residual = conv(self.channels, (1, 1),
+                            strides=(self.stride, self.stride))(x)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class CifarResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for i, (n_blocks, channels) in enumerate(
+                zip(self.stage_sizes, (16, 32, 64))):
+            for b in range(n_blocks):
+                stride = 2 if (i > 0 and b == 0) else 1
+                x = BasicBlock(channels, stride, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes,
+                     kernel_init=nn.initializers.lecun_normal(),
+                     dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet20(num_classes: int = 10, **kwargs) -> CifarResNet:
+    return CifarResNet(stage_sizes=(3, 3, 3), num_classes=num_classes,
+                       **kwargs)
+
+
+def resnet110(num_classes: int = 10, **kwargs) -> CifarResNet:
+    return CifarResNet(stage_sizes=(18, 18, 18), num_classes=num_classes,
+                       **kwargs)

@@ -1,0 +1,130 @@
+"""ImageNet ResNets (ResNet-18 / ResNet-50) in flax.linen, NHWC.
+
+Parity targets: the reference uses ``torchvision.models.{resnet18, resnet50}``
+with ``zero_init_residual=True`` (/root/reference/configs/imagenet/resnet18.py:
+1-10, resnet50.py:1-12): 7×7/64 stride-2 stem + 3×3 maxpool, four stages
+(BasicBlock ×[2,2,2,2] for 18; Bottleneck ×[3,4,6,3] with 4× expansion for
+50), global average pool, linear classifier.
+
+``zero_init_residual`` zero-initializes the scale of each block's final
+BatchNorm so residual branches start as identity (arXiv:1706.02677, the same
+large-batch recipe the reference harness follows for LR warm-up).
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "resnet18", "resnet50"]
+
+conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class BasicBlock(nn.Module):
+    channels: int
+    stride: int = 1
+    zero_init_residual: bool = False
+    dtype: Any = jnp.float32
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
+                       dtype=self.dtype)
+        out_ch = self.channels * self.expansion
+
+        residual = x
+        y = conv(self.channels, (3, 3), strides=(self.stride, self.stride),
+                 padding=1)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.channels, (3, 3), padding=1)(y)
+        y = norm(scale_init=nn.initializers.zeros
+                 if self.zero_init_residual else nn.initializers.ones)(y)
+
+        if residual.shape != y.shape:
+            residual = conv(out_ch, (1, 1),
+                            strides=(self.stride, self.stride))(x)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    channels: int
+    stride: int = 1
+    zero_init_residual: bool = False
+    dtype: Any = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
+                       dtype=self.dtype)
+        out_ch = self.channels * self.expansion
+
+        residual = x
+        y = conv(self.channels, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.channels, (3, 3), strides=(self.stride, self.stride),
+                 padding=1)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(out_ch, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros
+                 if self.zero_init_residual else nn.initializers.ones)(y)
+
+        if residual.shape != y.shape:
+            residual = conv(out_ch, (1, 1),
+                            strides=(self.stride, self.stride))(x)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: Any = BasicBlock
+    num_classes: int = 1000
+    zero_init_residual: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            channels = 64 * (2 ** i)
+            for b in range(n_blocks):
+                stride = 2 if (i > 0 and b == 0) else 1
+                x = self.block(channels, stride,
+                               zero_init_residual=self.zero_init_residual,
+                               dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes,
+                     kernel_init=nn.initializers.lecun_normal(),
+                     dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes: int = 1000, zero_init_residual: bool = False,
+             **kwargs) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock,
+                  num_classes=num_classes,
+                  zero_init_residual=zero_init_residual, **kwargs)
+
+
+def resnet50(num_classes: int = 1000, zero_init_residual: bool = False,
+             **kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=Bottleneck,
+                  num_classes=num_classes,
+                  zero_init_residual=zero_init_residual, **kwargs)

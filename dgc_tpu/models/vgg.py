@@ -1,0 +1,61 @@
+"""VGG-16 with BatchNorm in flax.linen, NHWC.
+
+Parity target: ``torchvision.models.vgg16_bn``
+(/root/reference/configs/imagenet/vgg16_bn.py:1-8): conv stages
+[64,64,M,128,128,M,256,256,256,M,512,512,512,M,512,512,512,M] with BN+ReLU
+after every conv, then a 4096-4096-num_classes classifier with dropout.
+
+The torchvision adaptive-avg-pool-to-7×7 is an ordinary 224→7 pipeline here
+(224 inputs reach the classifier at 7×7 already); other input sizes are pooled
+to 7×7 via mean-pool with matching window.
+"""
+
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["VGG", "vgg16_bn"]
+
+VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+             512, 512, 512, "M", 512, 512, 512, "M")
+
+conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]] = VGG16_CFG
+    num_classes: int = 1000
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, kernel_init=conv_init,
+                            dtype=self.dtype)(x)
+                x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=self.dtype)(x)
+                x = nn.relu(x)
+        # adaptive pool to 7x7 (identity for 224-sized inputs)
+        h, w = x.shape[1], x.shape[2]
+        if (h, w) != (7, 7):
+            assert h % 7 == 0 and w % 7 == 0, \
+                f"VGG input spatial dims must reduce to a multiple of 7, got {h}x{w}"
+            x = nn.avg_pool(x, (h // 7, w // 7), strides=(h // 7, w // 7))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def vgg16_bn(num_classes: int = 1000, **kwargs) -> VGG:
+    return VGG(num_classes=num_classes, **kwargs)

@@ -1,0 +1,19 @@
+from dgc_tpu.ops.sparsify import (
+    strided_sample,
+    uniform_sample,
+    topk_threshold,
+    adapt_threshold,
+    select_by_threshold,
+    scatter_add_dense,
+    transmitted_mask,
+)
+
+__all__ = [
+    "strided_sample",
+    "uniform_sample",
+    "topk_threshold",
+    "adapt_threshold",
+    "select_by_threshold",
+    "scatter_add_dense",
+    "transmitted_mask",
+]

@@ -1,0 +1,125 @@
+"""Pure-jnp reference implementations of the DGC sparsification ops.
+
+These define the numerics contract (SURVEY.md §2); any accelerated (Pallas)
+variant of an op must stay numerically compatible with the implementation
+here and be tested against it.
+
+TPU-first reformulation: the reference extracts a variable-length index set
+with ``mask.nonzero()`` and truncates it (``/root/reference/dgc/compression.py:
+109-153``), which is data-dependent and cannot compile under ``jit``. Here
+every op has a static shape:
+
+* mask→indices becomes ``top_k`` over threshold-masked importance plus a
+  validity mask — always exactly ``num_selects`` slots, with invalid slots
+  padded to (index 0, value 0.0), which is a no-op under scatter-add (the
+  decompress contract tolerates duplicate/zero contributions, SURVEY.md §2.5);
+* the threshold-adaptation loop becomes a bounded ``lax.while_loop`` on the
+  scalar threshold;
+* when more than ``num_selects`` elements pass the threshold, we send the top
+  ``num_selects`` *by importance* — the reference with ``resample=True`` does
+  the same (exact re-top-k on the hit set); with ``resample=False`` the
+  reference truncates in index order, an arbitrary subset. We always keep the
+  most important ones (a strict improvement; the contract is statistical, not
+  bitwise — SURVEY.md "hard parts" #4).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def strided_sample(importance: jax.Array, num_samples: int, stride: int,
+                   key: jax.Array) -> jax.Array:
+    """Strided subsample with a random phase (reference compression.py:117-119)."""
+    start = jax.random.randint(key, (), 0, stride, dtype=jnp.int32)
+    offsets = jnp.arange(num_samples, dtype=jnp.int32) * stride
+    return importance[start + offsets]
+
+
+def uniform_sample(importance: jax.Array, num_samples: int,
+                   key: jax.Array) -> jax.Array:
+    """Uniform with-replacement subsample (reference compression.py:121)."""
+    idx = jax.random.randint(key, (num_samples,), 0, importance.shape[0],
+                             dtype=jnp.int32)
+    return importance[idx]
+
+
+def topk_threshold(samples: jax.Array, k: int) -> jax.Array:
+    """min(top_k(samples, k)) — the k-th largest sample (compression.py:123)."""
+    return jax.lax.top_k(samples, k)[0][-1]
+
+
+def adapt_threshold(importance: jax.Array, threshold: jax.Array,
+                    num_selects: int, lower_bound: float, upper_bound: float,
+                    max_iters: int, resample: bool) -> jax.Array:
+    """Bounded threshold adaptation (reference compression.py:128-149).
+
+    Lowers the threshold (×lower_bound) while too few elements pass
+    (< lower_bound·num_selects); with ``resample=False`` also raises it
+    (×upper_bound) while too many pass (> upper_bound·num_selects). With
+    ``resample=True`` overflow needs no adaptation here because the final
+    fixed-size selection (:func:`select_by_threshold`) is already an exact
+    top-k over the hit set — the same resolution the reference applies.
+    """
+    lo = lower_bound * num_selects
+    hi = upper_bound * num_selects
+
+    def count(thr):
+        return jnp.sum(importance >= thr)
+
+    # carry the count so each iteration does ONE full reduction, not two
+    def cond(carry):
+        thr, c, it = carry
+        adapt = c < lo if resample else ((c < lo) | (c > hi))
+        return (it < max_iters) & adapt
+
+    def body(carry):
+        thr, c, it = carry
+        thr = jnp.where(c < lo, thr * lower_bound,
+                        jnp.where(c > hi, thr * upper_bound, thr))
+        return thr, count(thr), it + 1
+
+    thr, _, _ = jax.lax.while_loop(
+        cond, body, (threshold, count(threshold), jnp.int32(0)))
+    return thr
+
+
+def select_by_threshold(flat: jax.Array, importance: jax.Array,
+                        threshold: jax.Array, num_selects: int):
+    """Fixed-size selection of the ≤num_selects most important elements passing
+    ``threshold``.
+
+    Returns ``(values, indices, valid)`` each of length ``num_selects``;
+    invalid (padded) slots hold (0.0, 0, False).
+    """
+    scores = jnp.where(importance >= threshold, importance,
+                       -jnp.ones_like(importance))
+    top_scores, indices = jax.lax.top_k(scores, num_selects)
+    valid = top_scores >= 0
+    indices = jnp.where(valid, indices.astype(jnp.int32), 0)
+    values = jnp.where(valid, flat[indices], jnp.zeros((), flat.dtype))
+    return values, indices, valid
+
+
+def scatter_add_dense(numel: int, indices: jax.Array, values: jax.Array,
+                      dtype=None) -> jax.Array:
+    """Dense accumulation of sparse (indices, values) — the TPU equivalent of
+    the reference's ``index_put_(accumulate=True)`` (compression.py:191)."""
+    dtype = dtype or values.dtype
+    out = jnp.zeros((numel,), dtype)
+    return out.at[indices.reshape(-1)].add(values.reshape(-1).astype(dtype))
+
+
+def transmitted_mask(numel: int, indices: jax.Array,
+                     valid: jax.Array) -> jax.Array:
+    """Boolean mask of coordinates actually transmitted.
+
+    Padded slots (valid=False, index=0) must NOT mark coordinate 0 — the
+    scatter writes max(0, valid) so only genuinely selected indices are set.
+    Used by the memory masking step (reference memory.py:72-77 uses
+    ``index_fill_`` on the raw index list, which is safe there because its
+    index list is variable-length and unpadded).
+    """
+    hits = jnp.zeros((numel,), jnp.int32).at[indices].max(valid.astype(jnp.int32))
+    return hits > 0

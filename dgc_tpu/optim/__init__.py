@@ -1,0 +1,4 @@
+from dgc_tpu.optim.sgd import SGDState, dgc_sgd, sgd
+from dgc_tpu.optim.distributed import DistributedOptimizer
+
+__all__ = ["SGDState", "dgc_sgd", "sgd", "DistributedOptimizer"]

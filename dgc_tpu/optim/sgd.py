@@ -1,0 +1,144 @@
+"""SGD optimizers as optax-style gradient transformations.
+
+``dgc_sgd`` replicates the reference's ``DGCSGD`` (/root/reference/dgc/optim/
+sgd.py:30-70) — the critical *optimizer split* (SURVEY.md §2.9): gradient
+momentum is applied **pre-compression** inside the DGC memory, so the optimizer
+must NOT re-apply momentum to the gradient. It applies momentum + nesterov only
+to the weight-decay term: ``d_p = wd·p`` runs through the momentum buffer, then
+the (already momentum-corrected, decompressed) gradient is added raw, and the
+parameter moves by ``-lr · d_p``.
+
+``sgd`` replicates stock ``torch.optim.SGD`` (momentum buffer over
+``grad + wd·p``) for the dense/no-DGC baseline, so compressed and dense runs
+differ only in the gradient path.
+
+Both take ``lr`` as a float or a ``step -> lr`` schedule (the harness drives
+per-step warm-up through it, SURVEY.md §2.10) and an optional
+``weight_decay_mask`` pytree/callable marking which parameters receive weight
+decay (the reference's ``optimize_bn_separately`` puts BN params in a wd=0
+group, train.py:121-125).
+"""
+
+from typing import Any, Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["dgc_sgd", "sgd", "SGDState"]
+
+ScalarOrSchedule = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+class SGDState(NamedTuple):
+    count: jax.Array          # int32 step counter
+    momentum_buffer: Any      # pytree like params (None when unused)
+
+
+def _lr_at(lr: ScalarOrSchedule, count):
+    return lr(count) if callable(lr) else lr
+
+
+def _wd_mask_flat(weight_decay_mask, params, treedef):
+    if weight_decay_mask is None:
+        return [True] * treedef.num_leaves
+    mask = (weight_decay_mask(params) if callable(weight_decay_mask)
+            else weight_decay_mask)
+    return jax.tree.leaves(mask)
+
+
+def _make_sgd(per_param_fn, lr, momentum, weight_decay, weight_decay_mask,
+              use_buf):
+    """Shared scaffolding: flatten, apply per_param_fn per leaf, unflatten."""
+
+    def init(params):
+        buf = jax.tree.map(jnp.zeros_like, params) if use_buf else None
+        return SGDState(count=jnp.zeros((), jnp.int32), momentum_buffer=buf)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("this transformation requires params")
+        lr_t = _lr_at(lr, state.count)
+        first = state.count == 0
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_p = treedef.flatten_up_to(params)
+        flat_buf = (treedef.flatten_up_to(state.momentum_buffer)
+                    if use_buf else [None] * len(flat_g))
+        flat_mask = _wd_mask_flat(weight_decay_mask, params, treedef)
+
+        flat_updates, flat_new_buf = [], []
+        for g, p, buf, m_wd in zip(flat_g, flat_p, flat_buf, flat_mask):
+            upd, new_buf = per_param_fn(g, p, buf, m_wd, lr_t, first)
+            flat_updates.append(upd)
+            flat_new_buf.append(new_buf)
+
+        updates = jax.tree.unflatten(treedef, flat_updates)
+        new_buf = (jax.tree.unflatten(treedef, flat_new_buf)
+                   if use_buf else None)
+        return updates, SGDState(count=state.count + 1,
+                                 momentum_buffer=new_buf)
+
+    return optax.GradientTransformation(init, update)
+
+
+def dgc_sgd(lr: ScalarOrSchedule, momentum: float = 0.9,
+            dampening: float = 0.0, weight_decay: float = 0.0,
+            nesterov: bool = False,
+            weight_decay_mask=None) -> optax.GradientTransformation:
+    """DGC-split SGD (reference sgd.py:30-70).
+
+    Per parameter: ``d_p = wd·p``; momentum buffer ``buf = m·buf +
+    (1-dampening)·d_p`` (first step: ``buf = d_p`` exactly, matching torch's
+    clone-init); ``d_p += m·buf`` (nesterov) or ``d_p = buf``; then
+    ``p ← p - lr·(d_p + grad)`` — the gradient bypasses the momentum buffer.
+    """
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    use_buf = weight_decay != 0 and momentum != 0
+
+    def per_param(g, p, buf, m_wd, lr_t, first):
+        wd = weight_decay if m_wd else 0.0
+        if wd != 0:
+            d_p = wd * p
+            if momentum != 0:
+                new_buf = jnp.where(first, d_p,
+                                    momentum * buf + (1 - dampening) * d_p)
+                d_p = d_p + momentum * new_buf if nesterov else new_buf
+            else:
+                new_buf = buf
+            d_p = d_p + g
+        else:
+            # buffer still advances on wd-masked params? No: reference keeps
+            # per-group wd; a wd=0 group never touches its buffer (sgd.py:51).
+            d_p = g
+            new_buf = buf
+        return -lr_t * d_p, new_buf
+
+    return _make_sgd(per_param, lr, momentum, weight_decay,
+                     weight_decay_mask, use_buf)
+
+
+def sgd(lr: ScalarOrSchedule, momentum: float = 0.0, dampening: float = 0.0,
+        weight_decay: float = 0.0, nesterov: bool = False,
+        weight_decay_mask=None) -> optax.GradientTransformation:
+    """Stock torch-semantics SGD for the dense baseline: ``d_p = g + wd·p``;
+    ``buf = m·buf + (1-dampening)·d_p`` (first step ``buf = d_p``); nesterov
+    ``d_p += m·buf`` else ``d_p = buf``; ``p ← p - lr·d_p``."""
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    use_buf = momentum != 0
+
+    def per_param(g, p, buf, m_wd, lr_t, first):
+        d_p = g + (weight_decay * p if (weight_decay != 0 and m_wd) else 0.0)
+        if momentum != 0:
+            new_buf = jnp.where(first, d_p,
+                                momentum * buf + (1 - dampening) * d_p)
+            d_p = d_p + momentum * new_buf if nesterov else new_buf
+        else:
+            new_buf = buf
+        return -lr_t * d_p, new_buf
+
+    return _make_sgd(per_param, lr, momentum, weight_decay,
+                     weight_decay_mask, use_buf)

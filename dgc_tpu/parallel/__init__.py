@@ -1,0 +1,8 @@
+from dgc_tpu.parallel.mesh import (
+    DATA_AXIS,
+    data_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+
+__all__ = ["DATA_AXIS", "data_sharding", "make_mesh", "replicated_sharding"]

@@ -1,0 +1,44 @@
+"""Device mesh construction for data-parallel DGC training.
+
+Replaces the reference's process-per-GPU Horovod world (``hvd.init/size/rank``,
+/root/reference/train.py:412, dgc/compression.py:23) with a
+``jax.sharding.Mesh``. The reference system is data-parallel only (SURVEY.md
+§2 parallelism inventory); the mesh is therefore 1-D over a ``data`` axis, but
+constructed through this helper so future model-sharding axes compose without
+touching call sites.
+
+Parameter broadcast at init (train.py:167-173) is unnecessary: parameters are
+initialized from the same PRNG key on every worker, so replication holds by
+construction.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_sharding", "replicated_sharding", "DATA_AXIS"]
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None,
+              axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D data-parallel mesh over local (or provided) devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Shard leading axis over the data axis (batches, per-worker state)."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (parameters, optimizer state)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,18 @@
+from dgc_tpu.training.state import (
+    TrainState,
+    shard_state,
+    state_specs,
+    with_leading_axis,
+)
+from dgc_tpu.training.step import build_eval_step, build_train_step
+from dgc_tpu.training.lr import (
+    cosine_schedule,
+    make_lr_schedule,
+    multistep_schedule,
+)
+
+__all__ = [
+    "TrainState", "shard_state", "state_specs", "with_leading_axis",
+    "build_eval_step", "build_train_step",
+    "cosine_schedule", "make_lr_schedule", "multistep_schedule",
+]

@@ -1,0 +1,76 @@
+"""Learning-rate schedules replicating the reference recipe (SURVEY.md §2.10).
+
+The reference scales the configured LR by ``num_batches_per_step · world_size``
+(/root/reference/train.py:115-118), warms it up linearly from ``base_lr`` to
+the scaled LR over ``warmup_lr_epochs`` (fractional per step, train.py:335-343,
+per arXiv:1706.02677), then hands over to a per-epoch scheduler — cosine
+(CIFAR, configs/cifar/__init__.py:22-23) or MultiStep with milestones shifted
+by the warm-up epochs (ImageNet, configs/imagenet/__init__.py:23-26).
+
+Here the whole thing is one pure ``step_count -> lr`` function consumed by the
+optimizer transformation, so per-step warm-up needs no host-side mutation of
+optimizer state.
+"""
+
+import math
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["warmup_factor", "cosine_schedule", "multistep_schedule",
+           "make_lr_schedule"]
+
+
+def warmup_factor(epoch_f, world_size: int, warmup_epochs: float):
+    """Linear 1/size → 1 ramp of the *scaled* LR (train.py:337-343):
+    ``factor = (epoch_f·(size-1)/warmup + 1)/size``."""
+    return (epoch_f * (world_size - 1) / warmup_epochs + 1) / world_size
+
+
+def cosine_schedule(t_max: float, eta_min: float = 0.0) -> Callable:
+    """torch.optim.lr_scheduler.CosineAnnealingLR over epochs-after-warmup."""
+    def fn(t):
+        return eta_min + (1 - eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * t / t_max))
+    return fn
+
+
+def multistep_schedule(milestones: Sequence[float], gamma: float = 0.1
+                       ) -> Callable:
+    """torch.optim.lr_scheduler.MultiStepLR (milestones in epochs-after-warmup)."""
+    ms = jnp.asarray(sorted(milestones), jnp.float32)
+
+    def fn(t):
+        passed = jnp.sum(t >= ms)
+        return gamma ** passed
+    return fn
+
+
+def make_lr_schedule(scaled_lr: float, world_size: int,
+                     num_steps_per_epoch: int,
+                     warmup_lr_epochs: float = 0,
+                     decay: Optional[Callable] = None,
+                     schedule_lr_per_epoch: bool = True) -> Callable:
+    """Compose warm-up + decay into one ``step_count -> lr`` function.
+
+    ``decay`` maps epochs-after-warmup (fractional if
+    ``schedule_lr_per_epoch=False``) to a multiplicative factor in (0, 1].
+    """
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        epoch_f = count / num_steps_per_epoch
+        in_warmup = epoch_f < warmup_lr_epochs
+
+        wf = (warmup_factor(epoch_f, world_size, warmup_lr_epochs)
+              if warmup_lr_epochs > 0 else 1.0)
+
+        t = epoch_f - warmup_lr_epochs
+        if schedule_lr_per_epoch:
+            t = jnp.floor(t)
+        t = jnp.maximum(t, 0.0)
+        df = decay(t) if decay is not None else 1.0
+
+        factor = jnp.where(in_warmup, wf, df) if warmup_lr_epochs > 0 else df
+        return scaled_lr * factor
+
+    return schedule

@@ -1,0 +1,178 @@
+"""Jitted train/eval steps over the device mesh.
+
+The reference's hot loop (/root/reference/train.py:267-301 + the hook machinery
+in dgc/horovod/optimizer.py:105-194) — micro-batch forward/backward, per-tensor
+async compress+allgather during backward, drain + decompress + SGD in
+``optimizer.step()`` — collapses here into ONE jitted XLA program per step:
+
+    shard_map over mesh('data'):
+        scan over micro-batches: forward + backward (grad accumulation)
+        compress (momentum-corrected sampled top-k, per worker)
+        all_gather (values, indices) over the data axis   [ICI]
+        scatter-add + average; dense psum fallback for 1-D params
+        DGCSGD update (replicated)
+
+XLA's latency-hiding scheduler overlaps the collectives with independent
+compute, replacing the reference's Python-managed async handles; there is no
+``synchronize()`` because the dataflow graph *is* the synchronization.
+
+Only parameters with ndim > 1 are compressed (reference train.py:136-140);
+biases and BatchNorm fall through to dense psum.
+"""
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dgc_tpu.optim.distributed import DistributedOptimizer
+from dgc_tpu.training.state import TrainState, state_specs
+
+__all__ = ["build_train_step", "build_eval_step", "make_loss_fn"]
+
+
+def _squeeze0(tree):
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _expand0(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def make_loss_fn(apply_fn: Callable) -> Callable:
+    """Cross-entropy loss closure over a flax apply_fn with BN mutation
+    (the reference criterion is CrossEntropyLoss, configs/__init__.py:17)."""
+
+    def loss_fn(params, batch_stats, images, labels, scale, dropout_key):
+        variables = {"params": params}
+        rngs = None
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        if dropout_key is not None:
+            rngs = {"dropout": dropout_key}
+        if batch_stats:
+            logits, updated = apply_fn(variables, images, train=True,
+                                       mutable=["batch_stats"], rngs=rngs)
+            new_stats = updated["batch_stats"]
+        else:
+            logits = apply_fn(variables, images, train=True, rngs=rngs)
+            new_stats = batch_stats
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean() * scale
+        return loss, new_stats
+
+    return loss_fn
+
+
+def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
+                     mesh: Mesh, num_batches_per_step: int = 1,
+                     use_dropout: bool = False, donate: bool = True):
+    """Build the jitted data-parallel DGC train step.
+
+    Returns ``step_fn(state, images, labels, key) -> (state, metrics)`` where
+    ``images`` is ``[world·nbps·bs, H, W, C]`` sharded on axis 0 and metrics
+    holds the psum-averaged loss (reference train.py:298). ``nbps`` micro-batch
+    gradient accumulation follows train.py:287-294: each micro-loss is scaled
+    by 1/nbps and gradients sum before a single exchange+update.
+    """
+    loss_fn = make_loss_fn(apply_fn)
+    world = dist_opt.world_size
+    axis = dist_opt.axis_name
+    nbps = num_batches_per_step
+    r_nbps = 1.0 / nbps
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def worker(state: TrainState, images, labels, key):
+        params = state.params
+        memory = _squeeze0(state.memory)
+        batch_stats = _squeeze0(state.batch_stats)
+
+        widx = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(key, widx)
+        dropout_key, sparsify_key = jax.random.split(key)
+
+        mb_images = images.reshape((nbps, -1) + images.shape[1:])
+        mb_labels = labels.reshape((nbps, -1))
+
+        def micro(carry, mb):
+            gsum, stats, losssum, i = carry
+            imgs, lbls = mb
+            dk = (jax.random.fold_in(dropout_key, i) if use_dropout else None)
+            (lval, new_stats), grads = grad_fn(params, stats, imgs, lbls,
+                                               r_nbps, dk)
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            return (gsum, new_stats, losssum + lval, i + 1), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (grads, batch_stats, loss, _), _ = jax.lax.scan(
+            micro, (zeros, batch_stats, jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.int32)),
+            (mb_images, mb_labels))
+
+        updates, opt_state, memory = dist_opt.update(
+            grads, state.opt_state, params, memory, sparsify_key)
+        params = optax.apply_updates(params, updates)
+
+        mean_loss = jax.lax.psum(loss, axis) / world
+
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            opt_state=opt_state,
+            memory=_expand0(memory),
+            batch_stats=_expand0(batch_stats),
+        )
+        return new_state, {"loss": mean_loss}
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step_fn(state, images, labels, key):
+        specs = state_specs(state)
+        sharded = jax.shard_map(
+            worker, mesh=mesh,
+            in_specs=(specs, P(axis), P(axis), P()),
+            out_specs=(specs, {"loss": P()}),
+            check_vma=False)
+        return sharded(state, images, labels, key)
+
+    return step_fn
+
+
+def build_eval_step(apply_fn: Callable, mesh: Mesh, world_size: int,
+                    axis: str = "data", topk: Tuple[int, ...] = (1, 5)):
+    """Jitted eval step: per-worker inference with local BN stats, top-k
+    correct counts Sum-reduced over the mesh (reference train.py:304-328)."""
+
+    def worker(params, batch_stats, images, labels):
+        batch_stats = _squeeze0(batch_stats)
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits = apply_fn(variables, images, train=False)
+        counts = {}
+        for k in topk:
+            kk = min(k, logits.shape[-1])
+            _, pred = jax.lax.top_k(logits, kk)
+            correct = jnp.any(pred == labels[:, None], axis=-1)
+            counts[f"top{k}"] = jax.lax.psum(
+                jnp.sum(correct.astype(jnp.int32)), axis)
+        counts["count"] = jax.lax.psum(
+            jnp.asarray(labels.shape[0], jnp.int32), axis)
+        return counts
+
+    @jax.jit
+    def eval_fn(params, batch_stats, images, labels):
+        out_specs = {f"top{k}": P() for k in topk}
+        out_specs["count"] = P()
+        sharded = jax.shard_map(
+            worker, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(), params),
+                      jax.tree.map(lambda _: P(axis), batch_stats),
+                      P(axis), P(axis)),
+            out_specs=out_specs,
+            check_vma=False)
+        return sharded(params, batch_stats, images, labels)
+
+    return eval_fn

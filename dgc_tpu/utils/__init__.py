@@ -1,0 +1,8 @@
+from dgc_tpu.utils.pytree import (
+    named_flatten,
+    named_leaves,
+    named_unflatten,
+    tree_names,
+)
+
+__all__ = ["named_flatten", "named_leaves", "named_unflatten", "tree_names"]

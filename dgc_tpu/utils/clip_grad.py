@@ -1,0 +1,63 @@
+"""Gradient clipping utilities (C7 parity, /root/reference/dgc/clip_grad.py).
+
+Local variants are pure per-tensor functions; *global* variants reduce the
+squared sum across the mesh axis with ``psum`` (the XLA equivalent of the
+reference's ``hvd.allreduce_``, clip_grad.py:29-42) and are meant to run
+inside ``shard_map``. All are pluggable into ``DGCSGDMemory`` via its
+``gradient_clipping`` argument (reference memory.py:34,52-53) — bind the
+axis name with ``functools.partial`` first.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["clip_grad_norm", "clip_grad_value",
+           "clip_grad_value_by_global_norm", "clip_grad_norm_2_by_global",
+           "global_norm_clipper"]
+
+
+def clip_grad_norm(grad, max_norm, norm_type=2):
+    """Scale ``grad`` so its norm is at most ``max_norm``
+    (reference clip_grad.py:10-20)."""
+    max_norm = float(max_norm)
+    if norm_type == float("inf"):
+        total_norm = jnp.max(jnp.abs(grad))
+    else:
+        total_norm = jnp.sum(jnp.abs(grad) ** norm_type) ** (1.0 / norm_type)
+    clip_coef = max_norm / (total_norm + 1e-6)
+    return jnp.where(clip_coef < 1, grad * clip_coef, grad)
+
+
+def clip_grad_value(grad, clip_value):
+    """Clamp elementwise to [-clip_value, clip_value] (clip_grad.py:23-25)."""
+    clip_value = float(clip_value)
+    return jnp.clip(grad, -clip_value, clip_value)
+
+
+def clip_grad_value_by_global_norm(grad, axis_name=None):
+    """Clamp elementwise to ±sqrt(mean over workers of sum(grad²))
+    (clip_grad.py:29-32)."""
+    sq = jnp.sum(jnp.square(grad))
+    if axis_name is not None:
+        sq = jax.lax.pmean(sq, axis_name)
+    clip_value = jnp.sqrt(sq)
+    return jnp.clip(grad, -clip_value, clip_value)
+
+
+def clip_grad_norm_2_by_global(grad, max_norm, axis_name=None):
+    """Scale by max_norm / global 2-norm (clip_grad.py:35-42)."""
+    max_norm = float(max_norm)
+    sq = jnp.sum(jnp.square(grad))
+    if axis_name is not None:
+        sq = jax.lax.pmean(sq, axis_name)
+    total_norm = jnp.sqrt(sq)
+    clip_coef = max_norm / (total_norm + 1e-6)
+    return jnp.where(clip_coef < 1, grad * clip_coef, grad)
+
+
+def global_norm_clipper(max_norm, axis_name="data"):
+    """Partial form ready to plug into ``DGCSGDMemory(gradient_clipping=...)``."""
+    return functools.partial(clip_grad_norm_2_by_global, max_norm=max_norm,
+                             axis_name=axis_name)

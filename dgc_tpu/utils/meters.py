@@ -1,0 +1,53 @@
+"""Metric meters — TopKClassMeter parity.
+
+Parity target: ``torchpack.mtpack.meters.TopKClassMeter`` with the
+update/data/set/compute protocol the reference harness drives
+(/root/reference/train.py:306-327): per-batch ``update(outputs, targets)``,
+``data()`` returning reducible scalars, cross-worker Sum reduction, ``set``
+with the reduced values, ``compute`` → accuracy %.
+
+In the TPU harness the per-batch top-k counts are usually computed on device
+and psum-reduced inside the eval step; ``set``/``compute`` then consume the
+reduced counts. ``update`` is kept for host-side/API-compatible use.
+"""
+
+import numpy as np
+
+__all__ = ["TopKClassMeter"]
+
+
+class TopKClassMeter:
+    def __init__(self, k: int = 1):
+        self.k = k
+        self.reset()
+
+    def reset(self):
+        self.num_correct = 0
+        self.num_examples = 0
+
+    def update(self, outputs, targets):
+        """outputs: [N, C] scores; targets: [N] integer labels."""
+        outputs = np.asarray(outputs)
+        targets = np.asarray(targets)
+        k = min(self.k, outputs.shape[-1])
+        topk = np.argpartition(-outputs, k - 1, axis=-1)[:, :k]
+        correct = (topk == targets[:, None]).any(axis=-1)
+        self.num_correct += int(correct.sum())
+        self.num_examples += int(targets.shape[0])
+
+    def update_counts(self, num_correct: int, num_examples: int):
+        self.num_correct += int(num_correct)
+        self.num_examples += int(num_examples)
+
+    def data(self):
+        return {"num_correct": self.num_correct,
+                "num_examples": self.num_examples}
+
+    def set(self, data):
+        self.num_correct = int(data["num_correct"])
+        self.num_examples = int(data["num_examples"])
+
+    def compute(self) -> float:
+        if self.num_examples == 0:
+            return 0.0
+        return 100.0 * self.num_correct / self.num_examples

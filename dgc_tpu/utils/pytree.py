@@ -1,0 +1,50 @@
+"""Named-pytree helpers.
+
+The compression engine is keyed by parameter *names* (the reference keys its
+per-tensor attributes and memory buffers by ``named_parameters()`` names,
+/root/reference/dgc/compression.py:56-89, /root/reference/dgc/memory.py:43-48).
+In JAX, parameters are nested dict pytrees; these helpers give every leaf a
+stable ``a/b/c`` path name and convert between the nested tree and a flat
+``{name: leaf}`` ordered dict.
+"""
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+
+def _key_str(k) -> str:
+    if isinstance(k, jax.tree_util.DictKey):
+        return str(k.key)
+    if isinstance(k, jax.tree_util.SequenceKey):
+        return str(k.idx)
+    if isinstance(k, jax.tree_util.GetAttrKey):
+        return str(k.name)
+    if isinstance(k, jax.tree_util.FlattenedIndexKey):
+        return str(k.key)
+    return str(k)
+
+
+def path_name(path: Tuple) -> str:
+    return "/".join(_key_str(k) for k in path)
+
+
+def named_leaves(tree: Any) -> List[Tuple[str, Any]]:
+    """Flatten ``tree`` to an ordered list of (path-name, leaf)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(path_name(path), leaf) for path, leaf in flat]
+
+
+def named_flatten(tree: Any) -> Tuple[Dict[str, Any], Any]:
+    """Flatten ``tree`` to ({name: leaf}, treedef) for later unflattening."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return {path_name(path): leaf for path, leaf in flat}, treedef
+
+
+def named_unflatten(named: Dict[str, Any], treedef: Any) -> Any:
+    """Inverse of :func:`named_flatten` (relies on insertion order)."""
+    return jax.tree_util.tree_unflatten(treedef, list(named.values()))
+
+
+def tree_names(tree: Any) -> List[str]:
+    return [name for name, _ in named_leaves(tree)]
