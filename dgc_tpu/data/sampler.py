@@ -14,7 +14,7 @@ from typing import Iterator, List
 
 import numpy as np
 
-__all__ = ["epoch_batches"]
+__all__ = ["epoch_batches", "num_steps_per_epoch"]
 
 
 def epoch_batches(n: int, global_batch: int, epoch: int, seed: int = 0,

@@ -47,8 +47,7 @@ def _wd_mask_flat(weight_decay_mask, params, treedef):
     return jax.tree.leaves(mask)
 
 
-def _make_sgd(per_param_fn, lr, momentum, weight_decay, weight_decay_mask,
-              use_buf):
+def _make_sgd(per_param_fn, lr, weight_decay_mask, use_buf):
     """Shared scaffolding: flatten, apply per_param_fn per leaf, unflatten."""
 
     def init(params):
@@ -115,8 +114,7 @@ def dgc_sgd(lr: ScalarOrSchedule, momentum: float = 0.9,
             new_buf = buf
         return -lr_t * d_p, new_buf
 
-    return _make_sgd(per_param, lr, momentum, weight_decay,
-                     weight_decay_mask, use_buf)
+    return _make_sgd(per_param, lr, weight_decay_mask, use_buf)
 
 
 def sgd(lr: ScalarOrSchedule, momentum: float = 0.0, dampening: float = 0.0,
@@ -140,5 +138,4 @@ def sgd(lr: ScalarOrSchedule, momentum: float = 0.0, dampening: float = 0.0,
             new_buf = buf
         return -lr_t * d_p, new_buf
 
-    return _make_sgd(per_param, lr, momentum, weight_decay,
-                     weight_decay_mask, use_buf)
+    return _make_sgd(per_param, lr, weight_decay_mask, use_buf)

@@ -27,10 +27,18 @@ def warmup_factor(epoch_f, world_size: int, warmup_epochs: float):
     return (epoch_f * (world_size - 1) / warmup_epochs + 1) / world_size
 
 
-def cosine_schedule(t_max: float, eta_min: float = 0.0) -> Callable:
-    """torch.optim.lr_scheduler.CosineAnnealingLR over epochs-after-warmup."""
+def cosine_schedule(t_max: float, eta_min_fraction: float = 0.0) -> Callable:
+    """Cosine annealing over epochs-after-warmup, as a multiplicative factor.
+
+    Matches torch CosineAnnealingLR's curve with ``eta_min = eta_min_fraction
+    · scaled_lr`` — NOTE the floor is a *fraction of the scaled LR*, not an
+    absolute LR (the factor is applied to ``scaled_lr`` by
+    :func:`make_lr_schedule`). The reference configs use eta_min = 0, where
+    the two parameterizations coincide.
+    """
     def fn(t):
-        return eta_min + (1 - eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * t / t_max))
+        return (eta_min_fraction + (1 - eta_min_fraction)
+                * 0.5 * (1 + jnp.cos(jnp.pi * t / t_max)))
     return fn
 
 

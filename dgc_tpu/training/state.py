@@ -40,20 +40,21 @@ def with_leading_axis(tree: Any, world_size: int) -> Any:
         if hasattr(x, "shape") else x, tree)
 
 
-def state_specs(state: TrainState) -> TrainState:
+def state_specs(state: TrainState, axis: str = "data") -> TrainState:
     """PartitionSpec pytree for shard_map in/out_specs."""
     return TrainState(
         step=P(),
         params=jax.tree.map(lambda _: P(), state.params),
         opt_state=jax.tree.map(lambda _: P(), state.opt_state),
-        memory=jax.tree.map(lambda _: P("data"), state.memory),
-        batch_stats=jax.tree.map(lambda _: P("data"), state.batch_stats),
+        memory=jax.tree.map(lambda _: P(axis), state.memory),
+        batch_stats=jax.tree.map(lambda _: P(axis), state.batch_stats),
     )
 
 
-def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
+def shard_state(state: TrainState, mesh: Mesh,
+                axis: str = "data") -> TrainState:
     """Place state on the mesh with the canonical shardings."""
-    specs = state_specs(state)
+    specs = state_specs(state, axis)
     return jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
         state, specs)

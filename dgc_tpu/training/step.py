@@ -129,7 +129,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step_fn(state, images, labels, key):
-        specs = state_specs(state)
+        specs = state_specs(state, axis)
         sharded = jax.shard_map(
             worker, mesh=mesh,
             in_specs=(specs, P(axis), P(axis), P()),

@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-fake-device CPU platform.
+
+Multi-worker semantics (shard_map, all_gather, psum) are exercised exactly on
+fake CPU devices (SURVEY.md §4 test strategy). NOTE: this environment's
+sitecustomize force-registers a TPU plugin and overrides JAX_PLATFORMS, so the
+platform must be re-set via jax.config *after* importing jax.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from dgc_tpu.parallel import make_mesh
+    assert len(jax.devices()) >= 8, "conftest failed to create 8 CPU devices"
+    return make_mesh(8)

@@ -1,0 +1,159 @@
+"""Multi-worker exchange semantics on the fake 8-device CPU mesh
+(SURVEY.md §2.5, §5 backend notes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from dgc_tpu import (
+    Compression,
+    DGCCompressor,
+    DGCSGDMemory,
+    DistributedOptimizer,
+    dgc_sgd,
+    sgd,
+)
+from dgc_tpu.training import with_leading_axis
+
+W = 8
+
+
+def _exchange_fn(dist, mesh):
+    def worker(grads, mem, key):
+        grads = jax.tree.map(lambda x: x[0], grads)
+        mem = jax.tree.map(lambda x: x[0], mem)
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        out, mem = dist.exchange(grads, mem, key)
+        return (jax.tree.map(lambda x: x[None], out),
+                jax.tree.map(lambda x: x[None], mem))
+
+    return jax.jit(jax.shard_map(
+        worker, mesh=mesh,
+        in_specs=(P("data"), P("data"), P()),
+        out_specs=(P("data"), P("data")),
+        check_vma=False))
+
+
+def test_dense_none_compressor_is_psum_average(mesh8):
+    dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=W)
+    rng = np.random.RandomState(0)
+    g = rng.randn(W, 32).astype(np.float32)
+    f = _exchange_fn(dist, mesh8)
+    out, _ = f({"w": jnp.asarray(g)}, {}, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(out["w"][0]), g.mean(0), rtol=1e-5)
+
+
+def test_fp16_compressor_roundtrip(mesh8):
+    dist = DistributedOptimizer(sgd(0.1), Compression.fp16(), world_size=W)
+    g = np.full((W, 16), 0.5, np.float32)
+    f = _exchange_fn(dist, mesh8)
+    out, _ = f({"w": jnp.asarray(g)}, {}, jax.random.PRNGKey(0))
+    assert out["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["w"][0]), 0.5)
+
+
+def test_dgc_exchange_matches_manual_oracle(mesh8):
+    """decompress(all_gather(compress(g))) == average of per-worker sparse
+    contributions, reconstructed from the velocity mask side-channel."""
+    comp = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9))
+    numel = 2304
+    comp.initialize([("conv", (numel, (3, 3, 16, 16)))])
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    rng = np.random.RandomState(1)
+    g = rng.randn(W, 3, 3, 16, 16).astype(np.float32)
+    mem = with_leading_axis(
+        comp.memory.init([("conv", np.zeros((3, 3, 16, 16), np.float32))]), W)
+
+    f = _exchange_fn(dist, mesh8)
+    out, mem1 = f({"conv": jnp.asarray(g)}, mem, jax.random.PRNGKey(0))
+
+    # every worker's decompressed gradient is identical
+    for w in range(1, W):
+        np.testing.assert_array_equal(np.asarray(out["conv"][0]),
+                                      np.asarray(out["conv"][w]))
+
+    # oracle: step-1 velocity == grad; transmitted coords are those whose
+    # velocity was zeroed; the exchanged grad is their sum / W
+    vec = g.reshape(W, -1)
+    expected = np.zeros(numel, np.float32)
+    ns = comp.attributes["conv"].num_selects
+    for w in range(W):
+        sent = np.asarray(mem1["velocities"]["conv"][w]) == 0
+        assert sent.sum() <= ns
+        expected[sent] += vec[w][sent]
+    expected /= W
+    np.testing.assert_allclose(np.asarray(out["conv"][0]).reshape(-1),
+                               expected, atol=1e-6)
+
+
+def test_dgc_mixed_dense_and_sparse(mesh8):
+    """dim>1 params go sparse; 1-D params dense with post-average momentum
+    correction (reference train.py:136-140, compression.py:198)."""
+    comp = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize([("w", (4096, (64, 64)))])  # bias NOT registered
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    g_w = np.random.RandomState(2).randn(W, 64, 64).astype(np.float32)
+    g_b = np.ones((W, 64), np.float32) * 2.0
+    mem = with_leading_axis(comp.memory.init(
+        [("w", np.zeros((64, 64), np.float32)),
+         ("b", np.zeros((64,), np.float32))]), W)
+    f = _exchange_fn(dist, mesh8)
+    out, mem1 = f({"w": jnp.asarray(g_w), "b": jnp.asarray(g_b)}, mem,
+                  jax.random.PRNGKey(0))
+    # dense: average (=2) then mmt = 0*m + 2 → 2
+    np.testing.assert_allclose(np.asarray(out["b"][0]), 2.0, rtol=1e-6)
+    # dense-path momentum advanced in memory
+    np.testing.assert_allclose(np.asarray(mem1["momentums"]["b"][0]), 2.0,
+                               rtol=1e-6)
+    # sparse side produced a (mostly) sparse result
+    nz = np.count_nonzero(np.asarray(out["w"][0]))
+    assert nz <= W * comp.attributes["w"].num_selects
+
+
+def test_fused_vs_unfused_identical(mesh8):
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize([("a", (1024, (32, 32))), ("c", (2048, (2, 32, 32)))])
+    rng = np.random.RandomState(3)
+    g = {"a": jnp.asarray(rng.randn(W, 32, 32), jnp.float32),
+         "c": jnp.asarray(rng.randn(W, 2, 32, 32), jnp.float32)}
+
+    def run(fuse):
+        dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=W,
+                                    fuse_payloads=fuse)
+        mem = with_leading_axis(comp.memory.init(
+            [("a", np.zeros((32, 32), np.float32)),
+             ("c", np.zeros((2, 32, 32), np.float32))]), W)
+        f = _exchange_fn(dist, mesh8)
+        out, _ = f(g, mem, jax.random.PRNGKey(0))
+        return out
+
+    fused, unfused = run(True), run(False)
+    for k in fused:
+        np.testing.assert_array_equal(np.asarray(fused[k]),
+                                      np.asarray(unfused[k]))
+
+
+def test_global_clip_helpers(mesh8):
+    from dgc_tpu.utils.clip_grad import (
+        clip_grad_norm_2_by_global,
+        clip_grad_value_by_global_norm,
+    )
+
+    def worker(g):
+        g = g[0]
+        out1 = clip_grad_norm_2_by_global(g, 1.0, axis_name="data")
+        out2 = clip_grad_value_by_global_norm(g, axis_name="data")
+        return out1[None], out2[None]
+
+    f = jax.jit(jax.shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
+                              out_specs=(P("data"), P("data")),
+                              check_vma=False))
+    g = np.full((W, 4), 2.0, np.float32)
+    out1, out2 = f(jnp.asarray(g))
+    # global sq-sum per worker = 16, mean = 16, norm = 4 → scaled by 1/4
+    np.testing.assert_allclose(np.asarray(out1[0]), 0.5, rtol=1e-5)
+    # clip value = 4 → unchanged
+    np.testing.assert_allclose(np.asarray(out2[0]), 2.0, rtol=1e-5)

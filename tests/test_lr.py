@@ -1,0 +1,52 @@
+"""LR schedule recipe (SURVEY.md §2.10, reference train.py:335-352)."""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.training import cosine_schedule, make_lr_schedule, multistep_schedule
+
+
+def test_warmup_ramp():
+    # base 0.1, world 8, nbps 1 → scaled 0.8; warmup 5 epochs of 10 steps
+    sched = make_lr_schedule(scaled_lr=0.8, world_size=8,
+                             num_steps_per_epoch=10, warmup_lr_epochs=5)
+    # step 0: factor = 1/8 → lr = base_lr = 0.1
+    assert float(sched(0)) == pytest.approx(0.1)
+    # mid-warmup epoch 2.5: factor = (2.5*7/5+1)/8 = 0.5625
+    assert float(sched(25)) == pytest.approx(0.8 * 0.5625)
+    # end of warmup: full scaled lr
+    assert float(sched(50)) == pytest.approx(0.8)
+
+
+def test_cosine_after_warmup():
+    decay = cosine_schedule(t_max=195)
+    sched = make_lr_schedule(scaled_lr=0.8, world_size=8,
+                             num_steps_per_epoch=10, warmup_lr_epochs=5,
+                             decay=decay)
+    # first post-warmup epoch: t=0 → full lr
+    assert float(sched(50)) == pytest.approx(0.8)
+    # halfway: t=97.5 epochs... use epoch 102 (t=97): cos curve in (0,1)
+    mid = float(sched(1020))
+    assert 0.0 < mid < 0.8
+    # per-epoch stepping: constant within an epoch
+    assert float(sched(1020)) == float(sched(1029))
+    # end: ~0
+    assert float(sched(10 * 200)) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_multistep():
+    decay = multistep_schedule(milestones=[25, 55, 75], gamma=0.1)
+    sched = make_lr_schedule(scaled_lr=1.0, world_size=8,
+                             num_steps_per_epoch=1, warmup_lr_epochs=5,
+                             decay=decay)
+    # epochs after warmup: e-5; milestones hit at real epochs 30, 60, 80
+    assert float(sched(10)) == pytest.approx(1.0)
+    assert float(sched(30)) == pytest.approx(0.1)
+    assert float(sched(60)) == pytest.approx(0.01)
+    assert float(sched(85)) == pytest.approx(0.001)
+
+
+def test_no_warmup():
+    sched = make_lr_schedule(scaled_lr=0.4, world_size=4,
+                             num_steps_per_epoch=10, warmup_lr_epochs=0)
+    assert float(sched(0)) == pytest.approx(0.4)

@@ -1,0 +1,110 @@
+"""Momentum-correction memory contract (SURVEY.md §2.3-2.4,
+reference memory.py:50-77)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.compression import DGCSGDMemory, Memory
+
+
+def _init(mem, shapes):
+    return mem.init([(n, np.zeros(s, np.float32)) for n, s in shapes.items()])
+
+
+def test_noop_memory_is_identity():
+    mem = Memory()
+    state = mem.init([("w", np.zeros(4))])
+    g = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    out, state2 = mem.compensate(state, "w", g)
+    assert np.allclose(out, g)
+    assert mem.update(state2, "w", None, None) == state2
+
+
+def test_momentum_correction_recurrence():
+    m = 0.9
+    mem = DGCSGDMemory(momentum=m)
+    state = _init(mem, {"w": (3,)})
+    g1 = jnp.asarray([1.0, 2.0, 3.0])
+    g2 = jnp.asarray([0.5, 0.5, 0.5])
+
+    out1, state = mem.compensate(state, "w", g1)
+    # mmt1 = g1; vec1 = g1
+    assert np.allclose(out1, g1)
+    out2, state = mem.compensate(state, "w", g2)
+    # mmt2 = 0.9*g1 + g2 ; vec2 = vec1 + mmt2
+    mmt2 = m * np.asarray(g1) + np.asarray(g2)
+    assert np.allclose(out2, np.asarray(g1) + mmt2)
+
+
+def test_nesterov_variant():
+    m = 0.9
+    mem = DGCSGDMemory(momentum=m, nesterov=True)
+    state = _init(mem, {"w": (2,)})
+    g = jnp.asarray([1.0, -1.0])
+    out, state = mem.compensate(state, "w", g)
+    # mmt = (0 + g)*m ; vec = 0 + mmt + g
+    assert np.allclose(out, m * np.asarray(g) + np.asarray(g))
+
+
+def test_non_accumulate_dense_path():
+    m = 0.9
+    mem = DGCSGDMemory(momentum=m)
+    state = _init(mem, {"b": (2,)})
+    g = jnp.asarray([2.0, 4.0])
+    out, state = mem.compensate(state, "b", g, accumulate=False)
+    assert np.allclose(out, g)  # mmt = 0*m + g
+    # velocities untouched on the dense path
+    assert np.allclose(state["velocities"]["b"], 0.0)
+    out2, state = mem.compensate(state, "b", g, accumulate=False)
+    assert np.allclose(out2, m * np.asarray(g) + np.asarray(g))
+
+
+def test_update_masks_transmitted_coordinates():
+    mem = DGCSGDMemory(momentum=0.9, momentum_masking=True)
+    state = _init(mem, {"w": (6,)})
+    g = jnp.arange(1.0, 7.0)
+    _, state = mem.compensate(state, "w", g)
+    idx = jnp.asarray([1, 4, 0], jnp.int32)
+    valid = jnp.asarray([True, True, False])  # padded slot points at 0
+    state = mem.update(state, "w", idx, valid)
+    vel = np.asarray(state["velocities"]["w"])
+    mmt = np.asarray(state["momentums"]["w"])
+    assert vel[1] == 0 and vel[4] == 0
+    assert mmt[1] == 0 and mmt[4] == 0
+    # coordinate 0 was only referenced by a padded slot: must survive
+    assert vel[0] == 1.0 and mmt[0] == 1.0
+    assert vel[2] == 3.0 and vel[3] == 4.0 and vel[5] == 6.0
+
+
+def test_momentum_masking_toggle():
+    mem = DGCSGDMemory(momentum=0.9, momentum_masking=False)
+    state = _init(mem, {"w": (4,)})
+    _, state = mem.compensate(state, "w", jnp.ones(4))
+    state = mem.update(state, "w", jnp.asarray([2], jnp.int32),
+                       jnp.asarray([True]))
+    assert np.asarray(state["velocities"]["w"])[2] == 0
+    assert np.asarray(state["momentums"]["w"])[2] == 1.0  # mm off: kept
+
+
+def test_gradient_clipping_hook():
+    calls = []
+
+    def clip(g):
+        calls.append(1)
+        return g * 0.5
+
+    mem = DGCSGDMemory(momentum=0.0, gradient_clipping=clip)
+    state = _init(mem, {"w": (2,)})
+    out, _ = mem.compensate(state, "w", jnp.asarray([2.0, 2.0]))
+    assert calls and np.allclose(out, [1.0, 1.0])
+
+
+def test_state_dict_roundtrip():
+    mem = DGCSGDMemory(momentum=0.9)
+    state = _init(mem, {"w": (3,), "b": (2,)})
+    _, state = mem.compensate(state, "w", jnp.ones(3))
+    saved = mem.state_dict(state)
+    fresh = _init(mem, {"w": (3,), "b": (2,)})
+    restored = mem.load_state_dict(fresh, saved)
+    assert np.allclose(restored["momentums"]["w"], 1.0)
+    assert np.allclose(restored["velocities"]["w"], 1.0)

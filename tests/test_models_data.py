@@ -1,0 +1,108 @@
+"""Model zoo shapes/param-counts and data pipeline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu.data import Synthetic, epoch_batches, num_steps_per_epoch
+from dgc_tpu.models import resnet20, resnet110, resnet18, resnet50, vgg16_bn
+
+
+def _count(params):
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def test_resnet20_shape_and_params():
+    model = resnet20(num_classes=10)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)),
+                   train=False)
+    out = model.apply(v, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 10)
+    # standard resnet20 ≈ 0.27M (0.272M with option-A, slightly more with
+    # projection shortcuts)
+    n = _count(v["params"])
+    assert 0.25e6 < n < 0.30e6, n
+
+
+def test_resnet110_params():
+    v = resnet110(num_classes=10).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    n = _count(v["params"])
+    assert 1.6e6 < n < 1.85e6, n  # standard ≈ 1.7M
+
+
+@pytest.mark.parametrize("ctor,expected", [
+    (resnet18, 11.7e6), (resnet50, 25.6e6)])
+def test_imagenet_resnets_params(ctor, expected):
+    v = ctor(num_classes=1000).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    n = _count(v["params"])
+    assert abs(n - expected) / expected < 0.02, n
+
+
+def test_resnet50_zero_init_residual():
+    v = resnet50(num_classes=10, zero_init_residual=True).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    # find at least one BN scale that is all zeros
+    zeros = [p for path, p in
+             jax.tree_util.tree_flatten_with_path(v["params"])[0]
+             if "scale" in str(path[-1]) and float(jnp.abs(p).sum()) == 0.0]
+    assert zeros
+
+
+def test_vgg16_bn_forward():
+    model = vgg16_bn(num_classes=100)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                   train=False)
+    out = model.apply(v, jnp.zeros((2, 224, 224, 3)), train=False)
+    assert out.shape == (2, 100)
+    n = _count(v["params"])
+    assert abs(n - 134.7e6) / 134.7e6 < 0.03, n  # torchvision ≈ 134.7M
+
+
+def test_vgg_dropout_needs_rng():
+    model = vgg16_bn(num_classes=10)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                   train=False)
+    out = model.apply(v, jnp.zeros((1, 224, 224, 3)), train=True,
+                      rngs={"dropout": jax.random.PRNGKey(1)},
+                      mutable=["batch_stats"])
+    assert out[0].shape == (1, 10)
+
+
+def test_synthetic_dataset_batches():
+    ds = Synthetic(num_classes=10, image_size=32, n_train=100, n_test=20)
+    split = ds["train"]
+    assert len(split) == 100
+    batches = list(epoch_batches(len(split), 32, epoch=0))
+    assert all(len(b) == 32 for b in batches)
+    assert len(batches) == num_steps_per_epoch(100, 32)
+    x, y = split.get_batch(batches[0])
+    assert x.shape == (32, 32, 32, 3) and x.dtype == np.float32
+    assert y.shape == (32,) and y.dtype == np.int32
+
+
+def test_epoch_batches_deterministic_per_epoch():
+    a = list(epoch_batches(100, 32, epoch=3, seed=5))
+    b = list(epoch_batches(100, 32, epoch=3, seed=5))
+    c = list(epoch_batches(100, 32, epoch=4, seed=5))
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    assert not all(np.array_equal(x, y) for x, y in zip(a, c))
+
+
+def test_epoch_batches_tiny_dataset_pads():
+    batches = list(epoch_batches(5, 16, epoch=0))
+    assert all(len(b) == 16 for b in batches)
+
+
+def test_meters():
+    from dgc_tpu.utils.meters import TopKClassMeter
+    m = TopKClassMeter(k=2)
+    outputs = np.asarray([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    m.update(outputs, np.asarray([0, 0]))  # top2 of row0 = {1,0} hit; row1 hit
+    assert m.compute() == 100.0
+    data = m.data()
+    m2 = TopKClassMeter(k=2)
+    m2.set({k: v * 4 for k, v in data.items()})  # simulated Sum-allreduce
+    assert m2.compute() == 100.0

@@ -1,0 +1,88 @@
+"""Sampling-geometry contract (SURVEY.md §2.1, reference compression.py:56-89)."""
+
+import math
+
+import pytest
+
+from dgc_tpu.compression import DGCCompressor, sampling_geometry
+
+
+def test_known_case_resnet_conv():
+    # 3x3x16x16 conv, ratio 0.01, sample 0.01: stride backs off 33→25→17→9
+    num_samples, stride = sampling_geometry(2304, 0.01, 0.01)
+    assert (num_samples, stride) == (256, 9)
+
+
+def test_invariants_across_sizes():
+    for numel in [100, 1000, 4096, 100000, 2359296, 25557032]:
+        for ratio in [0.001, 0.01, 0.05]:
+            for sr in [0.01, 0.1]:
+                ns, stride = sampling_geometry(numel, sr, ratio)
+                pct = math.ceil(numel * sr)
+                cpr = math.ceil(2 / ratio)
+                if numel <= cpr:
+                    assert stride == 1 and ns == numel
+                else:
+                    # enough samples to estimate the threshold
+                    assert ns >= min(max(pct, cpr), numel)
+                    assert ns == numel // stride
+                    assert stride >= 1
+
+
+def test_full_sampling():
+    ns, stride = sampling_geometry(5000, 1.0, 0.01)
+    assert (ns, stride) == (5000, 1)
+
+
+def test_initialize_attrs():
+    comp = DGCCompressor(0.001, sample_ratio=0.01)
+    comp.initialize([("w", (2359296, (3, 3, 512, 512)))])
+    a = comp.attributes["w"]
+    assert a.num_selects == math.ceil(2359296 * 0.001)
+    assert a.top_k_samples == math.ceil(a.num_samples * 0.001)
+    assert a.numel == 2359296 and a.shape == (3, 3, 512, 512)
+
+
+def test_ratio_normalization():
+    assert DGCCompressor(1000).compress_ratio == 0.001
+    assert DGCCompressor(0.25).compress_ratio == 0.25
+
+
+def test_sample_ratio_clamped():
+    # reference clamps sample_ratio to [0.01, 1.0] (compression.py:47)
+    assert DGCCompressor(0.01, sample_ratio=0.001).sample_ratio == 0.01
+    assert DGCCompressor(0.01, sample_ratio=2.0).sample_ratio == 1.0
+
+
+def test_warmup_schedule_default_coeff():
+    comp = DGCCompressor(0.001, warmup_epochs=5)
+    comp.initialize([("w", (100000, (100000,)))])
+    ratios = []
+    for epoch in range(7):
+        comp.warmup_compress_ratio(epoch)
+        ratios.append(comp.compress_ratio)
+    # warmup_coeff = 0.001**(1/6); ratio_e = coeff**(e+1) clamped at base
+    coeff = 0.001 ** (1.0 / 6)
+    for e in range(5):
+        assert ratios[e] == pytest.approx(max(coeff ** (e + 1), 0.001))
+    assert ratios[5] == 0.001 and ratios[6] == 0.001
+
+
+def test_warmup_schedule_explicit_list():
+    comp = DGCCompressor(0.001, warmup_epochs=5,
+                         warmup_coeff=[0.25, 0.063, 0.015, 0.004, 0.001])
+    comp.initialize([("w", (100000, (100000,)))])
+    got = []
+    for epoch in range(6):
+        comp.warmup_compress_ratio(epoch)
+        got.append(comp.compress_ratio)
+    assert got == [0.25, 0.063, 0.015, 0.004, 0.001, 0.001]
+
+
+def test_warmup_changed_flag_and_reinit():
+    comp = DGCCompressor(0.001, warmup_epochs=2)
+    comp.initialize([("w", (50000, (50000,)))])
+    ns0 = comp.attributes["w"].num_selects
+    assert comp.warmup_compress_ratio(0) is True
+    assert comp.attributes["w"].num_selects > ns0  # looser ratio => more
+    assert comp.warmup_compress_ratio(0) is False  # no change => no re-init
