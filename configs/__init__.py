@@ -1,0 +1,30 @@
+"""Base config (parity: /root/reference/configs/__init__.py)."""
+
+from dgc_tpu.utils.config import Config, configs
+from dgc_tpu.utils.meters import TopKClassMeter
+from dgc_tpu.compression import Compression
+from dgc_tpu.optim import sgd
+
+configs.seed = 42
+configs.data = Config()
+configs.data.num_threads_per_worker = 4
+
+# criterion (cross-entropy is built into the train step)
+configs.train = Config()
+configs.train.dgc = False
+configs.train.compression = Config(Compression.none)
+configs.train.criterion = "cross_entropy"
+
+# optimizer (stock SGD unless the dgc config swaps it)
+configs.train.optimizer = Config(sgd)
+configs.train.optimizer.momentum = 0.9
+
+# scheduler
+configs.train.schedule_lr_per_epoch = True
+configs.train.warmup_lr_epochs = 5
+
+# metrics
+configs.train.metric = "acc/test_top1"
+configs.train.meters = Config()
+configs.train.meters["acc/{}_top1"] = Config(TopKClassMeter, k=1)
+configs.train.meters["acc/{}_top5"] = Config(TopKClassMeter, k=5)

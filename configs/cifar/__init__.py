@@ -1,0 +1,24 @@
+"""CIFAR-10 dataset + training recipe (parity: /root/reference/configs/cifar/__init__.py)."""
+
+from dgc_tpu.data import CIFAR
+from dgc_tpu.training import cosine_schedule
+from dgc_tpu.utils.config import Config, configs
+
+# dataset
+configs.dataset = Config(CIFAR)
+configs.dataset.root = "./data/cifar10"
+configs.dataset.num_classes = 10
+configs.dataset.image_size = 32
+
+# training
+configs.train.num_epochs = 200
+configs.train.batch_size = 128
+
+# optimizer
+configs.train.optimizer.lr = 0.1
+configs.train.optimizer.weight_decay = 1e-4
+
+# scheduler: cosine over the post-warmup epochs
+configs.train.scheduler = Config(cosine_schedule)
+configs.train.scheduler.t_max = (configs.train.num_epochs
+                                 - configs.train.warmup_lr_epochs)

@@ -1,0 +1,2 @@
+# cosine is already the CIFAR default (parity with the reference's empty
+# configs/cifar/cosine.py flag module)

@@ -1,0 +1,6 @@
+from dgc_tpu.models import resnet110
+from dgc_tpu.utils.config import Config, configs
+
+# model
+configs.model = Config(resnet110)
+configs.model.num_classes = configs.dataset.num_classes

@@ -1,0 +1,25 @@
+"""DGC compression group (parity: /root/reference/configs/dgc/__init__.py):
+swaps the optimizer to the DGC-split SGD and registers the compressor +
+momentum-correction memory."""
+
+from dgc_tpu.compression import DGCCompressor, DGCSGDMemory
+from dgc_tpu.optim import dgc_sgd
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.dgc = True
+configs.train.compression = Config(DGCCompressor)
+configs.train.compression.compress_ratio = 0.001
+configs.train.compression.sample_ratio = 0.01
+configs.train.compression.strided_sample = True
+configs.train.compression.compress_upper_bound = 1.3
+configs.train.compression.compress_lower_bound = 0.8
+configs.train.compression.max_adaptation_iters = 10
+configs.train.compression.resample = True
+
+old_optimizer = configs.train.optimizer
+configs.train.optimizer = Config(dgc_sgd)
+for k, v in old_optimizer.items():
+    configs.train.optimizer[k] = v
+
+configs.train.compression.memory = Config(DGCSGDMemory)
+configs.train.compression.memory.momentum = configs.train.optimizer.momentum

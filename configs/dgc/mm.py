@@ -1,0 +1,3 @@
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.compression.memory.momentum_masking = True

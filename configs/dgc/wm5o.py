@@ -1,0 +1,4 @@
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.compression.warmup_epochs = 5
+configs.train.compression.warmup_coeff = [1, 1, 1, 1, 1]

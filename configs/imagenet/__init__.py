@@ -1,0 +1,26 @@
+"""ImageNet dataset + training recipe (parity: /root/reference/configs/imagenet/__init__.py)."""
+
+from dgc_tpu.data import ImageNet
+from dgc_tpu.training import multistep_schedule
+from dgc_tpu.utils.config import Config, configs
+
+# dataset
+configs.dataset = Config(ImageNet)
+configs.dataset.root = "./data/imagenet"
+configs.dataset.num_classes = 1000
+configs.dataset.image_size = 224
+
+# training
+configs.train.num_epochs = 90
+configs.train.batch_size = 32
+
+# optimizer
+configs.train.optimize_bn_separately = False
+configs.train.optimizer.lr = 0.0125
+configs.train.optimizer.weight_decay = 5e-5
+
+# scheduler: MultiStep with milestones shifted by the warm-up epochs
+configs.train.scheduler = Config(multistep_schedule)
+configs.train.scheduler.milestones = [e - configs.train.warmup_lr_epochs
+                                      for e in [30, 60, 80]]
+configs.train.scheduler.gamma = 0.1

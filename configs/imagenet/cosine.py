@@ -1,0 +1,7 @@
+from dgc_tpu.training import cosine_schedule
+from dgc_tpu.utils.config import Config, configs
+
+# scheduler override: cosine over the post-warmup epochs
+configs.train.scheduler = Config(cosine_schedule)
+configs.train.scheduler.t_max = (configs.train.num_epochs
+                                 - configs.train.warmup_lr_epochs)

@@ -1,0 +1,10 @@
+from dgc_tpu.models import resnet18
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.batch_size = 64
+configs.train.optimizer.lr = 0.025
+
+# model
+configs.model = Config(resnet18)
+configs.model.num_classes = configs.dataset.num_classes
+configs.model.zero_init_residual = True

@@ -1,0 +1,11 @@
+from dgc_tpu.models import resnet50
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.optimizer.weight_decay = 1e-4
+configs.train.optimizer.nesterov = True
+configs.train.optimize_bn_separately = True
+
+# model
+configs.model = Config(resnet50)
+configs.model.num_classes = configs.dataset.num_classes
+configs.model.zero_init_residual = True

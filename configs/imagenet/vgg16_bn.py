@@ -1,0 +1,6 @@
+from dgc_tpu.models import vgg16_bn
+from dgc_tpu.utils.config import Config, configs
+
+# model
+configs.model = Config(vgg16_bn)
+configs.model.num_classes = configs.dataset.num_classes

@@ -1,0 +1,192 @@
+"""Composable config system — mini-torchpack ``Config`` parity.
+
+Replicates the de-facto API surface the reference harness builds on
+(``torchpack.mtpack.utils.config.{Config, configs}``, /root/reference/
+train.py:15,34-35 and every file under /root/reference/configs/):
+
+* ``configs`` is a global tree-of-dicts namespace mutated by config modules;
+* a config *module* is an ordinary Python file executed in CLI order, later
+  files overriding earlier ones (``Config.update_from_modules``);
+* dotted CLI overrides: ``--train.num_epochs 500``
+  (``Config.update_from_arguments``);
+* ``Config(callable)`` nodes instantiate their callable on call, passing the
+  stored fields as keyword arguments plus any call-time args/kwargs
+  (reference usage: ``configs.model()``, ``configs.train.optimizer(params)``,
+  train.py:81,111,127).
+"""
+
+import ast
+import os
+import runpy
+from typing import Any, Callable, Optional
+
+__all__ = ["Config", "configs"]
+
+_FN_KEY = "__fn__"
+
+
+class Config(dict):
+    """Attribute-accessible dict; optionally wraps a callable."""
+
+    def __init__(self, fn: Optional[Callable] = None, **kwargs):
+        super().__init__()
+        if fn is not None:
+            if not callable(fn):
+                raise TypeError(f"Config callable must be callable, got {fn!r}")
+            dict.__setitem__(self, _FN_KEY, fn)
+        for k, v in kwargs.items():
+            self[k] = v
+
+    # ---- attribute protocol ------------------------------------------- #
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = value
+
+    def __delattr__(self, name: str) -> None:
+        try:
+            del self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    # ---- dict cosmetics ------------------------------------------------ #
+
+    def keys(self):
+        return (k for k in super().keys() if k != _FN_KEY)
+
+    def items(self):
+        return ((k, v) for k, v in super().items() if k != _FN_KEY)
+
+    def values(self):
+        return (v for k, v in super().items() if k != _FN_KEY)
+
+    def __iter__(self):
+        return iter(list(self.keys()))
+
+    def __len__(self):
+        return sum(1 for _ in self.keys())
+
+    def __contains__(self, key):
+        return key != _FN_KEY and super().__contains__(key)
+
+    # ---- callable-node protocol ---------------------------------------- #
+
+    @property
+    def callable(self) -> Optional[Callable]:
+        return super().get(_FN_KEY)
+
+    def __call__(self, *args, **overrides):
+        fn = self.callable
+        if fn is None:
+            raise TypeError("this Config node has no callable to instantiate")
+        kwargs = {k: v for k, v in self.items()}
+        kwargs.update(overrides)
+        return fn(*args, **kwargs)
+
+    # ---- pretty print --------------------------------------------------- #
+
+    def _format(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = []
+        fn = self.callable
+        if fn is not None:
+            name = getattr(fn, "__name__", repr(fn))
+            lines.append(f"{pad}[callable] {name}")
+        for k, v in self.items():
+            if isinstance(v, Config):
+                lines.append(f"{pad}{k}:")
+                lines.append(v._format(indent + 1))
+            else:
+                lines.append(f"{pad}{k}: {v!r}")
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self._format()
+
+    def __repr__(self) -> str:
+        fn = self.callable
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.items())
+        if fn is not None:
+            inner = f"{getattr(fn, '__name__', fn)!s}" + (
+                ", " + inner if inner else "")
+        return f"Config({inner})"
+
+    # ---- module / CLI composition --------------------------------------- #
+
+    @staticmethod
+    def update_from_modules(*paths: str) -> None:
+        """Execute config .py files in order; they mutate the global
+        ``configs`` (reference train.py:34).
+
+        For each path like ``configs/cifar/resnet20.py`` the package
+        ``__init__.py`` files along the way (``configs/__init__.py``,
+        ``configs/cifar/__init__.py``) run first, each at most once per call
+        — so ``--configs configs/cifar/resnet20.py configs/dgc/wm5.py``
+        composes base + dataset group + model + dgc group + flag, exactly
+        like the reference CLI.
+        """
+        seen = set()
+
+        def run_once(p):
+            p = os.path.normpath(p)
+            if p not in seen and os.path.isfile(p):
+                seen.add(p)
+                runpy.run_path(p)
+
+        for path in paths:
+            if not path.endswith(".py"):
+                path = path + ".py"
+            if not os.path.isfile(path):
+                raise FileNotFoundError(f"config module not found: {path}")
+            # package chain: every ancestor dir holding an __init__.py,
+            # outermost first (works for absolute paths and any cwd)
+            chain = []
+            d = os.path.dirname(os.path.abspath(path))
+            while os.path.isfile(os.path.join(d, "__init__.py")):
+                chain.append(os.path.join(d, "__init__.py"))
+                parent = os.path.dirname(d)
+                if parent == d:
+                    break
+                d = parent
+            for init in reversed(chain):
+                run_once(init)
+            run_once(path)
+
+    @staticmethod
+    def update_from_arguments(*opts: str) -> None:
+        """Apply dotted overrides: ``--a.b.c value`` pairs
+        (reference train.py:35)."""
+        i = 0
+        while i < len(opts):
+            opt = opts[i]
+            if not opt.startswith("--"):
+                raise ValueError(f"expected --dotted.key, got {opt!r}")
+            keys = opt[2:].split(".")
+            if i + 1 >= len(opts):
+                raise ValueError(f"missing value for {opt}")
+            raw = opts[i + 1]
+            try:
+                value = ast.literal_eval(raw)
+            except (ValueError, SyntaxError):
+                value = raw
+            node = configs
+            for k in keys[:-1]:
+                if k not in node:
+                    node[k] = Config()
+                node = node[k]
+            node[keys[-1]] = value
+            i += 2
+
+    @staticmethod
+    def reset() -> None:
+        """Clear the global namespace (between runs / in tests)."""
+        configs.clear()
+
+
+#: the global config namespace, mirroring torchpack's module-level singleton
+configs = Config()

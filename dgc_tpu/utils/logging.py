@@ -1,0 +1,48 @@
+"""Metrics logging — rank-0 console + JSONL scalar stream.
+
+The reference logs per-step train loss and per-epoch meters to tensorboardX
+with x-axis = cumulative samples seen (/root/reference/train.py:197-201,
+235-242,299-301) and prints through a rank-0-only ``printr``
+(train.py:406-408). tensorboardX is not available in this environment, so the
+scalar stream is JSONL (one ``{"tag", "value", "step"}`` object per line) —
+trivially convertible; if tensorboardX is importable it is used additionally.
+"""
+
+import json
+import os
+from typing import Optional
+
+__all__ = ["MetricWriter", "printr"]
+
+
+def printr(*args, **kwargs):
+    """Process-0-only print. Single-controller JAX: always prints; kept for
+    API parity and multi-process deployments."""
+    import jax
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+class MetricWriter:
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._f = open(os.path.join(logdir, "metrics.jsonl"), "a")
+        self._tb = None
+        try:
+            from tensorboardX import SummaryWriter  # optional
+            self._tb = SummaryWriter(logdir)
+        except ImportError:
+            pass
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._f.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+        self._f.flush()
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+
+    def close(self):
+        self._f.close()
+        if self._tb is not None:
+            self._tb.close()

@@ -1,0 +1,76 @@
+"""Checkpoint save/resume/rotate (SURVEY.md §3.4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu.training import TrainState
+from dgc_tpu.training.checkpoint import CheckpointManager
+
+
+def _state(value: float) -> TrainState:
+    return TrainState(
+        step=jnp.asarray(int(value), jnp.int32),
+        params={"w": jnp.full((4,), value)},
+        opt_state=(jnp.zeros(()),),
+        memory={"momentums": {"a/b": jnp.full((3,), value)},
+                "velocities": {"a/b": jnp.full((3,), value * 2)}},
+        batch_stats={"bn": {"mean": jnp.zeros((2, 4))}},
+    )
+
+
+def test_roundtrip_includes_memory(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, _state(1.5), {"acc/test_top1": 50.0})
+    out = mgr.restore(_state(0.0))
+    assert out is not None
+    state, epoch, meters = out
+    assert epoch == 0
+    assert meters["acc/test_top1"] == 50.0
+    np.testing.assert_allclose(state.params["w"], 1.5)
+    np.testing.assert_allclose(state.memory["momentums"]["a/b"], 1.5)
+    np.testing.assert_allclose(state.memory["velocities"]["a/b"], 3.0)
+    assert int(state.step) == 1
+
+
+def test_latest_pointer_and_rotation(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    for e in range(5):
+        mgr.save(e, _state(float(e)), {})
+    assert mgr.latest_epoch() == 4
+    # keep last 3: e2, e3, e4
+    assert not os.path.exists(os.path.join(tmp_path, "e0"))
+    assert not os.path.exists(os.path.join(tmp_path, "e1"))
+    for e in (2, 3, 4):
+        assert os.path.exists(os.path.join(tmp_path, f"e{e}"))
+    state, epoch, _ = mgr.restore(_state(0.0))
+    assert epoch == 4
+    np.testing.assert_allclose(state.params["w"], 4.0)
+
+
+def test_best_tracking(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    mgr.save(0, _state(10.0), {"m": 1.0}, best=True)
+    mgr.save(1, _state(20.0), {"m": 0.5}, best=False)
+    out = mgr.restore(_state(0.0), best=True)
+    assert out is not None
+    state, _, meters = out
+    np.testing.assert_allclose(state.params["w"], 10.0)
+    assert meters["m"] == 1.0
+
+
+def test_restore_none_when_empty(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.restore(_state(0.0)) is None
+    assert mgr.latest_epoch() is None
+
+
+def test_overwrite_same_epoch(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, _state(1.0), {})
+    mgr.save(0, _state(2.0), {})
+    state, _, _ = mgr.restore(_state(0.0))
+    np.testing.assert_allclose(state.params["w"], 2.0)

@@ -1,0 +1,116 @@
+"""Config engine parity (C9/C12, reference train.py:34-35 + configs/**)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dgc_tpu.utils.config import Config, configs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_configs():
+    Config.reset()
+    yield
+    Config.reset()
+
+
+def test_attribute_access_and_nesting():
+    configs.train = Config()
+    configs.train.lr = 0.1
+    assert configs.train.lr == 0.1
+    assert configs["train"]["lr"] == 0.1
+    assert "train" in configs
+    assert "seed" not in configs
+    assert configs.get("missing", 5) == 5
+
+
+def test_callable_node_instantiation():
+    class Thing:
+        def __init__(self, a, b=2, c=3):
+            self.a, self.b, self.c = a, b, c
+
+    node = Config(Thing)
+    node.b = 20
+    obj = node(1, c=30)
+    assert (obj.a, obj.b, obj.c) == (1, 20, 30)
+
+
+def test_items_hide_callable():
+    node = Config(dict)
+    node.x = 1
+    assert dict(node.items()) == {"x": 1}
+    assert list(node.keys()) == ["x"]
+    assert len(node) == 1
+
+
+def test_update_from_arguments():
+    configs.train = Config()
+    configs.train.num_epochs = 200
+    Config.update_from_arguments("--train.num_epochs", "500",
+                                 "--train.tag", "hello",
+                                 "--train.lr", "0.05")
+    assert configs.train.num_epochs == 500
+    assert configs.train.tag == "hello"
+    assert configs.train.lr == 0.05
+
+
+def test_update_from_modules_composes(monkeypatch):
+    monkeypatch.chdir(REPO)
+    Config.update_from_modules("configs/cifar/resnet20.py",
+                               "configs/dgc/wm5.py")
+    # base config ran
+    assert configs.seed == 42
+    # cifar group ran
+    assert configs.train.num_epochs == 200
+    assert configs.dataset.num_classes == 10
+    # model leaf ran
+    assert configs.model.callable.__name__ == "resnet20"
+    # dgc group ran + flag module
+    assert configs.train.dgc is True
+    assert configs.train.compression.compress_ratio == 0.001
+    assert configs.train.compression.warmup_epochs == 5
+    # optimizer swapped to dgc_sgd, old fields carried over
+    assert configs.train.optimizer.callable.__name__ == "dgc_sgd"
+    assert configs.train.optimizer.momentum == 0.9
+    assert configs.train.compression.memory.momentum == 0.9
+
+
+def test_update_from_modules_dgc_flags(monkeypatch):
+    monkeypatch.chdir(REPO)
+    Config.update_from_modules("configs/cifar/resnet110.py",
+                               "configs/dgc/wm5o.py",
+                               "configs/dgc/fp16.py",
+                               "configs/dgc/int32.py",
+                               "configs/dgc/nm.py")
+    assert configs.model.callable.__name__ == "resnet110"
+    assert configs.train.compression.warmup_coeff == [1, 1, 1, 1, 1]
+    assert configs.train.compression.fp16_values is True
+    assert configs.train.compression.int32_indices is True
+    assert configs.train.compression.memory.momentum_masking is False
+
+
+def test_imagenet_configs(monkeypatch):
+    monkeypatch.chdir(REPO)
+    Config.update_from_modules("configs/imagenet/resnet50.py",
+                               "configs/imagenet/cosine.py",
+                               "configs/dgc/wm0.py")
+    assert configs.train.num_epochs == 90
+    assert configs.train.optimizer.nesterov is True
+    assert configs.train.optimize_bn_separately is True
+    assert configs.model.zero_init_residual is True
+    assert configs.train.scheduler.callable.__name__ == "cosine_schedule"
+    assert configs.train.compression.warmup_epochs == 0
+
+
+def test_get_save_path():
+    sys.path.insert(0, REPO)
+    from train import get_save_path
+    p = get_save_path("configs/cifar/resnet20.py", "configs/dgc/wm5.py")
+    assert p == os.path.join("runs", "cifar.resnet20+dgc.wm5")
+    assert "[" not in p  # tensorstore-globbing-safe
+    p2 = get_save_path("configs/imagenet/resnet50.py")
+    assert p2 == os.path.join("runs", "imagenet.resnet50")
