@@ -64,8 +64,10 @@ def main():
 
     if args.cpu_mesh or args.devices == "cpu":
         n = args.cpu_mesh or 1
-        os.environ.setdefault(
-            "XLA_FLAGS", f"--xla_force_host_platform_device_count={n}")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n}").strip()
     import jax
     if args.cpu_mesh or args.devices == "cpu":
         jax.config.update("jax_platforms", "cpu")
@@ -129,7 +131,8 @@ def main():
     variables = model.init(rng, jnp.zeros(sample_shape), train=True)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
-    use_dropout = "VGG" in type(model).__name__  # only VGG has dropout
+    # Always thread a dropout rng; flax ignores rngs a model doesn't use.
+    use_dropout = True
 
     named_params, _ = named_flatten(params)
 
@@ -254,7 +257,8 @@ def main():
                                          base_key, epoch * 100003 + bidx))
             seen += 1
             num_inputs += global_batch
-            if bidx % 50 == 0:
+            logged = bidx % 50 == 0
+            if logged:
                 writer.add_scalar("loss/train", float(metrics["loss"]),
                                   num_inputs)
         dt = time.time() - t0
@@ -265,7 +269,8 @@ def main():
             loss = float(metrics["loss"])
             printr(f"[loss] = {loss:.4f}  ({seen} steps, "
                    f"{dt / max(seen, 1) * 1000:.1f} ms/step)")
-            writer.add_scalar("loss/train", loss, num_inputs)
+            if not logged:
+                writer.add_scalar("loss/train", loss, num_inputs)
 
         meters = evaluate(state)
         best = False
