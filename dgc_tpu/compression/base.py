@@ -74,6 +74,11 @@ class _DenseCompressor(Compressor):
     def _unwire(self, grad, dtype):
         return grad
 
+    def make_flat_exchange(self, layout):
+        """Flat-path capability: one psum over the whole gradient buffer."""
+        from dgc_tpu.compression.flat import FlatDenseExchange
+        return FlatDenseExchange(self, layout)
+
     def compress(self, mem_state, name, grad, key):
         ctx = CompressCtx(name=name, numel=grad.size, shape=grad.shape,
                           dtype=grad.dtype, compressed=False)

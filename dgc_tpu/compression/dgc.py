@@ -170,6 +170,15 @@ class DGCCompressor(Compressor):
             return True
         return False
 
+    def make_flat_exchange(self, layout):
+        """Flat-path capability (see ``dgc_tpu.compression.flat``): fused
+        whole-model pipeline over a :class:`ParamLayout`. Discovered by the
+        distributed optimizer via duck typing, like the reference's
+        ``communicate``/``synchronize`` dispatch (optimizer.py:39-40).
+        Must be re-called after a compress-ratio change (new attributes)."""
+        from dgc_tpu.compression.flat import FlatDGCEngine
+        return FlatDGCEngine(self, layout)
+
     # ------------------------------------------------------------------ #
     # traced (pure) pieces                                               #
     # ------------------------------------------------------------------ #

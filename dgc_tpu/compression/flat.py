@@ -1,0 +1,469 @@
+"""Flat (bucketed) execution engine for the compression pipeline.
+
+The reference runs the DGC pipeline tensor-by-tensor: per-parameter hooks,
+per-tensor top-k, per-tensor collectives with named handles
+(/root/reference/dgc/horovod/optimizer.py:105-139, dgc/compression.py:155-212)
+— and its README lists the resulting per-tensor thresholding overhead and
+allgather volume as the system's known costs (README.md:130-138).
+
+On TPU the idiomatic answer (SURVEY.md §7 "hard parts" #3, and the north-star
+"Pallas kernels operating on HBM-resident gradient buffers") is to keep the
+whole gradient, the error-feedback memory, and the optimizer state as a few
+flat HBM-resident buffers and run the pipeline over them **fused**:
+
+* ``ParamLayout`` — a static flat [P] layout over every parameter, with the
+  DGC-compressed tensors packed first ([0, T)) and the dense-fallback tensors
+  (biases/BN, reference train.py:136-140) in the tail block [T, P). Flatten /
+  unflatten compile to pure data movement that XLA fuses away; only a handful
+  of buffers ever cross the jit boundary.
+* ``FlatDGCEngine`` — the sampled-top-k sparsification of every tensor runs as
+  a few *batched* ops over size-bucketed [rows, maxN] views generated on the
+  fly from the layout (no materialized index maps), followed by exactly two
+  ``all_gather`` collectives for the whole model and one scatter-add
+  decompress. Error-feedback compensate/update are single fused elementwise /
+  scatter ops over the [P] memory buffers.
+
+Numerics follow the same contract as the per-tensor path
+(``dgc_tpu.compression.dgc``, ``dgc_tpu.ops.sparsify``): per-tensor sampled
+thresholds, bounded adaptation, fixed ``num_selects`` payload per tensor (the
+wire volume matches the reference's exactly), scatter-add-then-average
+decompress, momentum correction and masking per SURVEY.md §2.3-2.5.
+"""
+
+import math
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.compression.memory import DGCSGDMemory, Memory
+from dgc_tpu.utils.pytree import named_flatten, named_unflatten
+
+__all__ = ["ParamLayout", "FlatDGCEngine", "FlatDenseExchange"]
+
+
+class ParamLayout:
+    """Static flat-buffer layout over a pytree of arrays.
+
+    Compressed names are packed first so the compressed block is the
+    contiguous prefix ``[0, t_compressed)`` and the dense fallback block the
+    suffix — one slice each, no gather.
+    """
+
+    def __init__(self, tree, compressed_names: Sequence[str] = ()):
+        named, self.treedef = named_flatten(tree)
+        compressed = [n for n in named if n in set(compressed_names)]
+        dense = [n for n in named if n not in set(compressed_names)]
+        self.names: List[str] = compressed + dense
+        self.compressed_names = compressed
+        self.shapes = {n: tuple(named[n].shape) for n in self.names}
+        self.sizes = {n: int(np.prod(self.shapes[n], dtype=np.int64))
+                      for n in self.names}
+        dtypes = {np.dtype(named[n].dtype) for n in self.names}
+        if len(dtypes) > 1:
+            raise ValueError(
+                f"flat layout requires a uniform dtype, got {dtypes}")
+        self.dtype = dtypes.pop() if dtypes else np.dtype(np.float32)
+        self.offsets: Dict[str, int] = {}
+        off = 0
+        for n in self.names:
+            self.offsets[n] = off
+            off += self.sizes[n]
+        self.total = off
+        self.t_compressed = sum(self.sizes[n] for n in compressed)
+        # insertion order of `named` (the treedef leaf order), for unflatten
+        self._tree_order = list(named)
+
+    @classmethod
+    def for_compressor(cls, tree, compressor) -> "ParamLayout":
+        """The canonical layout for a compressor: its initialized attributes
+        are the compressed names (the dim>1 selection the harness feeds to
+        ``initialize``, reference train.py:136-140). Single source of truth
+        for the compressed-first ordering — use this everywhere a layout and
+        an engine must agree on offsets."""
+        return cls(tree, list(getattr(compressor, "attributes", {}) or {}))
+
+    # -------------------------------------------------------------- #
+
+    def flatten(self, tree) -> jax.Array:
+        """Pytree -> flat [P] (layout order)."""
+        named, _ = named_flatten(tree)
+        return jnp.concatenate(
+            [jnp.ravel(named[n]) for n in self.names]) if self.names else (
+            jnp.zeros((0,), self.dtype))
+
+    def unflatten(self, flat: jax.Array):
+        """Flat [P] -> pytree with the original structure."""
+        named = {n: flat[self.offsets[n]:self.offsets[n] + self.sizes[n]]
+                 .reshape(self.shapes[n]) for n in self._tree_order}
+        return named_unflatten(named, self.treedef)
+
+    def unflatten_named(self, flat: jax.Array, keep_1d: bool = False):
+        """Flat [P] -> {name: array} (layout order)."""
+        out = {}
+        for n in self.names:
+            piece = flat[self.offsets[n]:self.offsets[n] + self.sizes[n]]
+            out[n] = piece if keep_1d else piece.reshape(self.shapes[n])
+        return out
+
+    def mask_vector(self, predicate) -> jax.Array:
+        """[P] 0/1 float mask from a per-name predicate (e.g. the
+        optimize_bn_separately weight-decay split, reference train.py:121-125).
+        """
+        out = np.zeros((self.total,), np.float32)
+        for n in self.names:
+            if predicate(n):
+                out[self.offsets[n]:self.offsets[n] + self.sizes[n]] = 1.0
+        return jnp.asarray(out)
+
+
+class _Bucket(NamedTuple):
+    """Size-bucketed batch of compressed tensors (all static, host-side)."""
+    row_offsets: np.ndarray    # [R] global offset of each tensor
+    numels: np.ndarray         # [R]
+    max_n: int
+    strides: np.ndarray        # [R] sampling stride
+    num_samples: np.ndarray    # [R]
+    max_s: int
+    topk_samples: np.ndarray   # [R]
+    max_k: int
+    num_selects: np.ndarray    # [R]
+    max_sel: int
+    adapt: np.ndarray          # [R] bool: run threshold adaptation
+    tight: np.ndarray          # [payload] positions into the [R*max_sel] grid
+    payload: int
+
+
+def _build_buckets(attributes, layout: ParamLayout,
+                   pad_factor: float = 2.0) -> List[_Bucket]:
+    """Group compressed tensors into size buckets (pad ratio <= pad_factor)
+    so the batched [R, maxN] views stay dense. Sorted by numel descending."""
+    names = sorted(layout.compressed_names, key=lambda n: -layout.sizes[n])
+    buckets: List[_Bucket] = []
+    group: List[str] = []
+
+    def flush(group):
+        if not group:
+            return
+        attrs = [attributes[n] for n in group]
+        num_selects = np.array([a.num_selects for a in attrs], np.int32)
+        max_sel = int(num_selects.max())
+        tight = np.concatenate([
+            np.arange(r * max_sel, r * max_sel + k, dtype=np.int64)
+            for r, k in enumerate(num_selects)])
+        buckets.append(_Bucket(
+            row_offsets=np.array([layout.offsets[n] for n in group], np.int32),
+            numels=np.array([a.numel for a in attrs], np.int32),
+            max_n=int(max(a.numel for a in attrs)),
+            strides=np.array([a.sample_stride for a in attrs], np.int32),
+            num_samples=np.array([a.num_samples for a in attrs], np.int32),
+            max_s=int(max(a.num_samples for a in attrs)),
+            topk_samples=np.array([a.top_k_samples for a in attrs], np.int32),
+            max_k=int(max(a.top_k_samples for a in attrs)),
+            num_selects=num_selects,
+            max_sel=max_sel,
+            adapt=np.array([a.numel > a.num_samples for a in attrs], bool),
+            tight=tight,
+            payload=int(num_selects.sum()),
+        ))
+
+    bucket_max = None
+    for n in names:
+        sz = layout.sizes[n]
+        if bucket_max is None or sz * pad_factor < bucket_max:
+            flush(group)
+            group, bucket_max = [], sz
+        group.append(n)
+    flush(group)
+    return buckets
+
+
+def _batched_adapt(imp_rows, thr, num_selects, adapt_mask, lower, upper,
+                   max_iters: int, resample: bool):
+    """Batched threshold adaptation — same per-row semantics as
+    ``ops.adapt_threshold`` (reference compression.py:128-149), run for all
+    rows of a bucket simultaneously in one bounded while_loop."""
+    lo = lower * num_selects
+    hi = upper * num_selects
+
+    def count(t):
+        return jnp.sum(imp_rows >= t[:, None], axis=1)
+
+    def need(c):
+        n = (c < lo) if resample else ((c < lo) | (c > hi))
+        return n & adapt_mask
+
+    def cond(carry):
+        t, c, it = carry
+        return (it < max_iters) & jnp.any(need(c))
+
+    def body(carry):
+        t, c, it = carry
+        nt = jnp.where(c < lo, t * lower, jnp.where(c > hi, t * upper, t))
+        nt = jnp.where(need(c), nt, t)
+        return nt, count(nt), it + 1
+
+    thr, _, _ = jax.lax.while_loop(cond, body,
+                                   (thr, count(thr), jnp.int32(0)))
+    return thr
+
+
+class FlatDGCEngine:
+    """Fused flat-buffer execution of the DGC pipeline for one compressor +
+    layout pair. Rebuilt (cheaply, host-side) whenever the warm-up schedule
+    changes the compress ratio (reference compression.py:91-107)."""
+
+    def __init__(self, compressor, layout: ParamLayout):
+        self.c = compressor
+        self.layout = layout
+        self.T = layout.t_compressed
+        self.buckets = _build_buckets(compressor.attributes, layout)
+        #: per-worker wire payload in elements — matches the reference's
+        #: sum of per-tensor num_selects exactly (compression.py:151)
+        self.payload_size = sum(b.payload for b in self.buckets)
+
+    # -------------------------------------------------------------- #
+    # memory (fused over the flat buffers)                           #
+    # -------------------------------------------------------------- #
+
+    @property
+    def _mem(self) -> Optional[DGCSGDMemory]:
+        m = self.c.memory
+        return m if isinstance(m, DGCSGDMemory) else None
+
+    def init_memory(self) -> Dict:
+        if self._mem is None:
+            return {}
+        z = jnp.zeros((self.layout.total,), self.layout.dtype)
+        return {"momentums": z, "velocities": z}
+
+    def _compensate_acc(self, mmt, vec, grad):
+        """Momentum correction + local accumulation (memory.py:50-63)."""
+        m = self._mem
+        if m is None:
+            return grad, mmt, vec
+        if m.nesterov:
+            mmt = (mmt + grad) * m.momentum
+            vec = vec + mmt + grad
+        else:
+            mmt = m.momentum * mmt + grad
+            vec = vec + mmt
+        return vec, mmt, vec
+
+    def _compensate_dense(self, mmt, grad):
+        """Non-accumulating correction for the dense-fallback block, applied
+        after averaging (reference compression.py:198, memory.py:64-70)."""
+        m = self._mem
+        if m is None:
+            return grad, mmt
+        if m.nesterov:
+            mmt = (mmt + grad) * m.momentum
+            return mmt + grad, mmt
+        mmt = m.momentum * mmt + grad
+        return mmt, mmt
+
+    # -------------------------------------------------------------- #
+    # sparsify (batched per bucket)                                  #
+    # -------------------------------------------------------------- #
+
+    def sparsify(self, vec_c: jax.Array, key: jax.Array):
+        """Sampled-top-k selection over the compressed block [T].
+
+        Returns tight ``(values, indices)`` of length ``payload_size``;
+        padded/invalid slots carry (0.0, T) — index T is the sentinel slot,
+        dropped by every consumer (SURVEY.md §2.5 tolerates zero/duplicate
+        contributions under scatter-add).
+        """
+        T = self.T
+        if not self.buckets:
+            return (jnp.zeros((0,), vec_c.dtype), jnp.zeros((0,), jnp.int32))
+        imp_ext = jnp.concatenate(
+            [jnp.abs(vec_c), jnp.full((1,), -1.0, vec_c.dtype)])
+        val_ext = jnp.concatenate([vec_c, jnp.zeros((1,), vec_c.dtype)])
+        out_v, out_i = [], []
+        for bi, b in enumerate(self.buckets):
+            k = jax.random.fold_in(key, bi)
+            R = b.row_offsets.shape[0]
+            row_off = jnp.asarray(b.row_offsets)[:, None]
+            numels = jnp.asarray(b.numels)[:, None]
+
+            # --- sampling positions (reference compression.py:113-121) ---
+            s_idx = jnp.arange(b.max_s, dtype=jnp.int32)[None, :]
+            s_valid = s_idx < jnp.asarray(b.num_samples)[:, None]
+            if self.c.strided_sample:
+                strides = jnp.asarray(b.strides)[:, None]
+                # random phase in [0, stride) per row; stride-1 rows (the
+                # sample-everything degenerate path) get phase 0 = exact
+                u = jax.random.uniform(k, (R, 1))
+                phase = jnp.floor(u * strides).astype(jnp.int32)
+                pos = phase + s_idx * strides
+            else:
+                u = jax.random.uniform(k, (R, b.max_s))
+                pos = jnp.floor(u * numels).astype(jnp.int32)
+                # rows sampling everything must sample exactly, not with
+                # replacement (per-tensor path's numel==num_samples branch,
+                # dgc.py sparsify)
+                exact = jnp.asarray(b.num_samples)[:, None] >= numels
+                pos = jnp.where(exact, jnp.minimum(s_idx, numels - 1), pos)
+            gpos = jnp.where(s_valid, row_off + pos, T)
+            samples = imp_ext[gpos]                          # [R, maxS]
+
+            # --- per-row sampled threshold (compression.py:123) ---
+            sorted_s = jax.lax.top_k(samples, b.max_k)[0]
+            thr = jnp.take_along_axis(
+                sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
+                axis=1)[:, 0]
+
+            # --- batched row view [R, maxN], generated on the fly ---
+            col = jnp.arange(b.max_n, dtype=jnp.int32)[None, :]
+            in_row = col < numels
+            rmap = jnp.where(in_row, row_off + col, T)
+            imp_rows = imp_ext[rmap]                         # [R, maxN]
+
+            # --- bounded threshold adaptation (compression.py:128-149) ---
+            if self.c.max_adaptation_iters > 0 and b.adapt.any():
+                thr = _batched_adapt(
+                    imp_rows, thr, jnp.asarray(b.num_selects, jnp.float32),
+                    jnp.asarray(b.adapt), self.c.compress_lower_bound,
+                    self.c.compress_upper_bound, self.c.max_adaptation_iters,
+                    self.c.resample)
+
+            # --- fixed-size selection (ops.select_by_threshold semantics) ---
+            scores = jnp.where(imp_rows >= thr[:, None], imp_rows,
+                               -jnp.ones_like(imp_rows))
+            top_scores, cols = jax.lax.top_k(scores, b.max_sel)
+            slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
+            valid = (top_scores >= 0) & (
+                slot < jnp.asarray(b.num_selects)[:, None])
+            gidx = jnp.where(valid, row_off + cols.astype(jnp.int32), T)
+            vals = val_ext[gidx]                             # 0.0 at sentinel
+
+            tight = jnp.asarray(b.tight)
+            out_v.append(vals.reshape(-1)[tight])
+            out_i.append(gidx.reshape(-1)[tight])
+        return jnp.concatenate(out_v), jnp.concatenate(out_i)
+
+    # -------------------------------------------------------------- #
+    # the full exchange                                              #
+    # -------------------------------------------------------------- #
+
+    def exchange(self, flat_grad: jax.Array, mem: Dict, key: jax.Array,
+                 axis_name: str, world_size: int):
+        """compress -> communicate -> decompress over the whole model:
+        two ``all_gather`` + one ``psum`` per step, total.
+
+        With no initialized compressed tensors (T == 0, e.g. an uninitialized
+        compressor) every parameter falls through to the dense psum block —
+        the same graceful degradation as the per-tensor path's
+        ``name in attributes`` guard."""
+        T, P = self.T, self.layout.total
+        m = self._mem
+        gc, gd = flat_grad[:T], flat_grad[T:]
+        if m is not None:
+            mmt, vec = mem["momentums"], mem["velocities"]
+            mc, vc, md = mmt[:T], vec[:T], mmt[T:]
+        else:
+            mc = vc = md = None
+
+        # --- compressed block: compensate -> sparsify -> mask -> gather ---
+        if m is not None:
+            if m.gradient_clipping is not None:
+                raise NotImplementedError(
+                    "per-tensor gradient clipping requires the per-tensor "
+                    "path: build the train step without flat= (it uses "
+                    "DistributedOptimizer.exchange per tensor)")
+            comp, mc, vc = self._compensate_acc(mc, vc, gc)
+        else:
+            comp = gc
+        values, indices = self.sparsify(comp, key)
+        if m is not None:
+            vc = vc.at[indices].set(0.0, mode="drop")
+            if m.momentum_masking:
+                mc = mc.at[indices].set(0.0, mode="drop")
+
+        wire_values = (values.astype(jnp.float16)
+                       if self.c.fp16_values else values)
+        g_values = jax.lax.all_gather(wire_values, axis_name)  # [W, payload]
+        g_indices = jax.lax.all_gather(indices, axis_name)
+
+        acc = jnp.zeros((T + 1,), flat_grad.dtype)
+        acc = acc.at[g_indices.reshape(-1)].add(
+            g_values.reshape(-1).astype(flat_grad.dtype))
+        out_c = acc[:T] / world_size      # hvd.Average (compression.py:192-193)
+
+        # --- dense fallback block: one psum + average + correction ---
+        if P > T:
+            gd_w = gd.astype(jnp.float16) if self.c.fp16_values else gd
+            gd_avg = jax.lax.psum(gd_w, axis_name).astype(
+                flat_grad.dtype) / world_size
+            out_d, md = self._compensate_dense(md, gd_avg)
+            out = jnp.concatenate([out_c, out_d])
+        else:
+            out = out_c
+
+        if m is not None:
+            mem = {"momentums": jnp.concatenate([mc, md]) if P > T else mc,
+                   "velocities": jnp.concatenate([vc, vec[T:]])
+                   if P > T else vc}
+        return out, mem
+
+    # -------------------------------------------------------------- #
+    # checkpoint-format parity (reference memory.py:79-88)           #
+    # -------------------------------------------------------------- #
+
+    def memory_state_dict(self, mem: Dict) -> Optional[Dict]:
+        """Flat memory -> per-name {momentums, velocities} (the reference's
+        checkpoint format, memory.py:79-80)."""
+        if not mem:
+            return None
+        return {
+            "momentums": self.layout.unflatten_named(mem["momentums"],
+                                                     keep_1d=True),
+            "velocities": self.layout.unflatten_named(mem["velocities"],
+                                                      keep_1d=True),
+        }
+
+    def load_memory_state_dict(self, mem: Dict, saved: Optional[Dict]) -> Dict:
+        """Per-name saved buffers -> flat memory, merging by name
+        (reference memory.py:82-88)."""
+        if not mem or saved is None:
+            return mem
+        mmt = self.layout.unflatten_named(mem["momentums"], keep_1d=True)
+        vec = self.layout.unflatten_named(mem["velocities"], keep_1d=True)
+        for n in mmt:
+            if n in saved["momentums"]:
+                mmt[n] = jnp.asarray(saved["momentums"][n]).reshape(-1)
+                vec[n] = jnp.asarray(saved["velocities"][n]).reshape(-1)
+        return {
+            "momentums": jnp.concatenate([mmt[n] for n in self.layout.names]),
+            "velocities": jnp.concatenate([vec[n] for n in self.layout.names]),
+        }
+
+
+class FlatDenseExchange:
+    """Flat-path counterpart for the dense baseline compressors
+    (``NoneCompressor``/``FP16Compressor``): one psum over the whole flat
+    gradient buffer."""
+
+    payload_size = 0
+
+    def __init__(self, compressor, layout: ParamLayout):
+        self.c = compressor
+        self.layout = layout
+
+    def init_memory(self) -> Dict:
+        return {}
+
+    def exchange(self, flat_grad, mem, key, axis_name, world_size):
+        wire = self.c._wire(flat_grad)
+        total = jax.lax.psum(wire, axis_name)
+        out = (self.c._unwire(total, flat_grad.dtype) / world_size).astype(
+            flat_grad.dtype)
+        return out, mem
+
+    def memory_state_dict(self, mem):
+        return None
+
+    def load_memory_state_dict(self, mem, saved):
+        return mem

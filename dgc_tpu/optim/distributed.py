@@ -63,6 +63,30 @@ class DistributedOptimizer:
         return self.compressor.memory.init(named.items())
 
     # ------------------------------------------------------------------ #
+    # flat-buffer path (see dgc_tpu.compression.flat)                    #
+    # ------------------------------------------------------------------ #
+
+    def make_flat(self, params):
+        """Build the (ParamLayout, engine) pair for the fused flat-buffer
+        pipeline. Compressed names are the compressor's initialized
+        attributes (the dim>1 selection, reference train.py:136-140).
+        Call again after ``warmup_compress_ratio`` changes the ratio."""
+        from dgc_tpu.compression.flat import ParamLayout
+        layout = ParamLayout.for_compressor(params, self.compressor)
+        engine = self.compressor.make_flat_exchange(layout)
+        return layout, engine
+
+    def update_flat(self, flat_grads, opt_state, flat_params, mem_state,
+                    key, engine):
+        """Flat-path analogue of :meth:`update`: fused exchange over the [P]
+        buffer, then the wrapped optimizer on the same buffer."""
+        exchanged, mem_state = engine.exchange(
+            flat_grads, mem_state, key, self.axis_name, self.world_size)
+        updates, opt_state = self.optimizer.update(exchanged, opt_state,
+                                                   flat_params)
+        return updates, opt_state, mem_state
+
+    # ------------------------------------------------------------------ #
 
     def exchange(self, grads, mem_state, key: Optional[jax.Array]
                  ) -> Tuple[Any, Dict]:

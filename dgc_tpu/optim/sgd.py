@@ -16,7 +16,10 @@ Both take ``lr`` as a float or a ``step -> lr`` schedule (the harness drives
 per-step warm-up through it, SURVEY.md §2.10) and an optional
 ``weight_decay_mask`` pytree/callable marking which parameters receive weight
 decay (the reference's ``optimize_bn_separately`` puts BN params in a wd=0
-group, train.py:121-125).
+group, train.py:121-125). Mask leaves may be booleans (whole-tensor groups,
+like the reference's param groups) or 0/1 *arrays* — the latter supports the
+flat-buffer path where all parameters live in one [P] array and the BN split
+becomes a per-coordinate mask (``ParamLayout.mask_vector``).
 """
 
 from typing import Any, Callable, NamedTuple, Union
@@ -97,6 +100,19 @@ def dgc_sgd(lr: ScalarOrSchedule, momentum: float = 0.9,
     use_buf = weight_decay != 0 and momentum != 0
 
     def per_param(g, p, buf, m_wd, lr_t, first):
+        if not isinstance(m_wd, (bool, int)):
+            # per-coordinate 0/1 mask (flat-buffer path)
+            mv = jnp.asarray(m_wd, p.dtype)
+            d_p = weight_decay * mv * p
+            if momentum != 0 and weight_decay != 0:
+                new_buf = jnp.where(first, d_p,
+                                    momentum * buf + (1 - dampening) * d_p)
+                # a wd=0 coordinate never touches its buffer (sgd.py:51)
+                new_buf = mv * new_buf + (1 - mv) * buf
+                d_p = d_p + momentum * new_buf if nesterov else new_buf
+            else:
+                new_buf = buf
+            return -lr_t * (mv * d_p + g), new_buf
         wd = weight_decay if m_wd else 0.0
         if wd != 0:
             d_p = wd * p
@@ -129,7 +145,13 @@ def sgd(lr: ScalarOrSchedule, momentum: float = 0.0, dampening: float = 0.0,
     use_buf = momentum != 0
 
     def per_param(g, p, buf, m_wd, lr_t, first):
-        d_p = g + (weight_decay * p if (weight_decay != 0 and m_wd) else 0.0)
+        if not isinstance(m_wd, (bool, int)):
+            # per-coordinate 0/1 mask gates only the wd term; momentum
+            # applies to every coordinate (stock torch SGD group semantics)
+            d_p = g + weight_decay * jnp.asarray(m_wd, p.dtype) * p
+        else:
+            d_p = g + (weight_decay * p
+                       if (weight_decay != 0 and m_wd) else 0.0)
         if momentum != 0:
             new_buf = jnp.where(first, d_p,
                                 momentum * buf + (1 - dampening) * d_p)

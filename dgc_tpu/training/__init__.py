@@ -4,7 +4,13 @@ from dgc_tpu.training.state import (
     state_specs,
     with_leading_axis,
 )
-from dgc_tpu.training.step import build_eval_step, build_train_step
+from dgc_tpu.training.step import (
+    FlatSetup,
+    build_eval_step,
+    build_train_step,
+    make_flat_setup,
+    make_flat_state,
+)
 from dgc_tpu.training.lr import (
     cosine_schedule,
     make_lr_schedule,
@@ -14,5 +20,6 @@ from dgc_tpu.training.lr import (
 __all__ = [
     "TrainState", "shard_state", "state_specs", "with_leading_axis",
     "build_eval_step", "build_train_step",
+    "FlatSetup", "make_flat_setup", "make_flat_state",
     "cosine_schedule", "make_lr_schedule", "multistep_schedule",
 ]

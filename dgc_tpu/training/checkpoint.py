@@ -101,7 +101,16 @@ class CheckpointManager:
                 return None
         host_template = jax.tree.map(
             lambda x: np.asarray(jax.device_get(x)), template)
-        state = self._ckptr.restore(path, host_template)
+        try:
+            state = self._ckptr.restore(path, host_template)
+        except ValueError as e:
+            # on-disk structure from an older/incompatible state layout
+            # (e.g. per-tensor vs flat buffers): train from scratch rather
+            # than crash — the reference likewise starts fresh when resume
+            # files are absent (train.py:154-165)
+            print(f"[checkpoint] incompatible checkpoint at {path}, "
+                  f"ignoring: {str(e).splitlines()[0]}")
+            return None
         meters_path = os.path.join(path, "meters.json")
         meters = {}
         if os.path.exists(meters_path):

@@ -21,7 +21,7 @@ biases and BatchNorm fall through to dense psum.
 """
 
 from functools import partial
-from typing import Callable, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,9 +29,44 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dgc_tpu.optim.distributed import DistributedOptimizer
-from dgc_tpu.training.state import TrainState, state_specs
+from dgc_tpu.training.state import TrainState, state_specs, with_leading_axis
 
-__all__ = ["build_train_step", "build_eval_step", "make_loss_fn"]
+__all__ = ["build_train_step", "build_eval_step", "make_loss_fn",
+           "FlatSetup", "make_flat_setup", "make_flat_state"]
+
+
+class FlatSetup(NamedTuple):
+    """Static layouts + engine for the flat-buffer step (see
+    ``dgc_tpu.compression.flat``): parameters, optimizer state, and memory
+    cross the jit boundary as a handful of flat [P]-sized HBM buffers instead
+    of hundreds of per-tensor arrays — per-buffer dispatch overhead dominates
+    small-model steps, and all unflattening fuses away inside the program."""
+    layout: Any          # ParamLayout over params
+    stats_layout: Any    # ParamLayout over batch_stats
+    engine: Any          # compressor flat-exchange engine
+
+
+def make_flat_setup(variables, dist_opt: DistributedOptimizer) -> FlatSetup:
+    """Build layouts + engine from initialized model variables. Rebuild after
+    a warm-up compress-ratio change (the engine holds ratio-derived attrs)."""
+    from dgc_tpu.compression.flat import ParamLayout
+    layout, engine = dist_opt.make_flat(variables["params"])
+    stats_layout = ParamLayout(variables.get("batch_stats", {}))
+    return FlatSetup(layout, stats_layout, engine)
+
+
+def make_flat_state(variables, dist_opt: DistributedOptimizer,
+                    setup: FlatSetup, world_size: int) -> TrainState:
+    """Initial flat TrainState (params/opt replicated; memory and BN stats
+    per-worker with a leading [world] axis, as in ``dgc_tpu.training.state``)."""
+    flat_params = setup.layout.flatten(variables["params"])
+    flat_stats = setup.stats_layout.flatten(variables.get("batch_stats", {}))
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=flat_params,
+        opt_state=dist_opt.init(flat_params),
+        memory=with_leading_axis(setup.engine.init_memory(), world_size),
+        batch_stats=with_leading_axis(flat_stats, world_size))
 
 
 def _squeeze0(tree):
@@ -69,7 +104,8 @@ def make_loss_fn(apply_fn: Callable) -> Callable:
 
 def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                      mesh: Mesh, num_batches_per_step: int = 1,
-                     use_dropout: bool = False, donate: bool = True):
+                     use_dropout: bool = False, donate: bool = True,
+                     flat: Optional[FlatSetup] = None):
     """Build the jitted data-parallel DGC train step.
 
     Returns ``step_fn(state, images, labels, key) -> (state, metrics)`` where
@@ -77,7 +113,15 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     holds the psum-averaged loss (reference train.py:298). ``nbps`` micro-batch
     gradient accumulation follows train.py:287-294: each micro-loss is scaled
     by 1/nbps and gradients sum before a single exchange+update.
+
+    With ``flat`` (a :class:`FlatSetup`), the state must come from
+    :func:`make_flat_state` and the whole pipeline runs over flat HBM buffers
+    (fused exchange, two collectives per step) — the default fast path.
     """
+    if flat is not None:
+        return _build_flat_train_step(apply_fn, dist_opt, mesh, flat,
+                                      num_batches_per_step, use_dropout,
+                                      donate)
     loss_fn = make_loss_fn(apply_fn)
     world = dist_opt.world_size
     axis = dist_opt.axis_name
@@ -140,13 +184,96 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     return step_fn
 
 
+def _build_flat_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
+                           mesh: Mesh, flat: FlatSetup,
+                           num_batches_per_step: int, use_dropout: bool,
+                           donate: bool):
+    """Flat-buffer train step: identical numerics to the per-tensor step, but
+    params/opt/memory are [P]-sized buffers and the exchange is the fused
+    engine (two all_gathers + one psum per step, SURVEY.md §7 hard-parts #3).
+    """
+    loss_fn = make_loss_fn(apply_fn)
+    layout, stats_layout, engine = flat
+    world = dist_opt.world_size
+    axis = dist_opt.axis_name
+    nbps = num_batches_per_step
+    r_nbps = 1.0 / nbps
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    has_stats = stats_layout.total > 0
+
+    def worker(state: TrainState, images, labels, key):
+        flat_params = state.params
+        params = layout.unflatten(flat_params)
+        memory = _squeeze0(state.memory)
+        flat_stats = _squeeze0(state.batch_stats)
+
+        widx = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(key, widx)
+        dropout_key, sparsify_key = jax.random.split(key)
+
+        mb_images = images.reshape((nbps, -1) + images.shape[1:])
+        mb_labels = labels.reshape((nbps, -1))
+
+        def micro(carry, mb):
+            gsum, fstats, losssum, i = carry
+            imgs, lbls = mb
+            dk = (jax.random.fold_in(dropout_key, i) if use_dropout else None)
+            stats = stats_layout.unflatten(fstats) if has_stats else {}
+            (lval, new_stats), grads = grad_fn(params, stats, imgs, lbls,
+                                               r_nbps, dk)
+            gsum = gsum + layout.flatten(grads)
+            fstats = (stats_layout.flatten(new_stats) if has_stats
+                      else fstats)
+            return (gsum, fstats, losssum + lval, i + 1), None
+
+        (flat_grads, flat_stats, loss, _), _ = jax.lax.scan(
+            micro, (jnp.zeros_like(flat_params), flat_stats,
+                    jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            (mb_images, mb_labels))
+
+        updates, opt_state, memory = dist_opt.update_flat(
+            flat_grads, state.opt_state, flat_params, memory, sparsify_key,
+            engine)
+        flat_params = flat_params + updates
+
+        mean_loss = jax.lax.psum(loss, axis) / world
+
+        new_state = TrainState(
+            step=state.step + 1,
+            params=flat_params,
+            opt_state=opt_state,
+            memory=_expand0(memory),
+            batch_stats=_expand0(flat_stats),
+        )
+        return new_state, {"loss": mean_loss}
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step_fn(state, images, labels, key):
+        specs = state_specs(state, axis)
+        sharded = jax.shard_map(
+            worker, mesh=mesh,
+            in_specs=(specs, P(axis), P(axis), P()),
+            out_specs=(specs, {"loss": P()}),
+            check_vma=False)
+        return sharded(state, images, labels, key)
+
+    return step_fn
+
+
 def build_eval_step(apply_fn: Callable, mesh: Mesh, world_size: int,
-                    axis: str = "data", topk: Tuple[int, ...] = (1, 5)):
+                    axis: str = "data", topk: Tuple[int, ...] = (1, 5),
+                    flat: Optional[FlatSetup] = None):
     """Jitted eval step: per-worker inference with local BN stats, top-k
-    correct counts Sum-reduced over the mesh (reference train.py:304-328)."""
+    correct counts Sum-reduced over the mesh (reference train.py:304-328).
+    With ``flat``, params/batch_stats are the flat buffers from the flat
+    train state."""
 
     def worker(params, batch_stats, images, labels):
         batch_stats = _squeeze0(batch_stats)
+        if flat is not None:
+            params = flat.layout.unflatten(params)
+            batch_stats = (flat.stats_layout.unflatten(batch_stats)
+                           if flat.stats_layout.total > 0 else {})
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats

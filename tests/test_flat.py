@@ -1,0 +1,347 @@
+"""Flat-buffer engine (dgc_tpu.compression.flat): layout roundtrips, flat-vs-
+per-tensor equivalence, vector weight-decay masks, and the flat train step on
+the fake 8-device CPU mesh.
+
+Equivalence strategy: with ``sample_ratio=1.0`` the sampled threshold is the
+exact k-th largest importance and no RNG enters selection, so the flat and
+per-tensor paths must produce identical exchanged gradients and memory state
+(modulo float op order)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dgc_tpu import (
+    Compression,
+    DGCCompressor,
+    DGCSGDMemory,
+    DistributedOptimizer,
+    dgc_sgd,
+    sgd,
+)
+from dgc_tpu.compression.flat import ParamLayout
+from dgc_tpu.utils.pytree import named_flatten
+
+W = 8
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "conv1": {"kernel": jnp.asarray(rng.randn(3, 3, 4, 8), jnp.float32)},
+        "conv2": {"kernel": jnp.asarray(rng.randn(3, 3, 8, 8), jnp.float32)},
+        "dense": {"kernel": jnp.asarray(rng.randn(32, 10), jnp.float32),
+                  "bias": jnp.asarray(rng.randn(10), jnp.float32)},
+        "bn": {"scale": jnp.asarray(rng.randn(8), jnp.float32)},
+    }
+
+
+def _make_dist(sample_ratio=1.0, ratio=0.05, **kw):
+    params = _params()
+    named, _ = named_flatten(params)
+    comp = DGCCompressor(ratio, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=sample_ratio, **kw)
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4),
+                                comp, world_size=W)
+    return params, comp, dist
+
+
+def test_layout_roundtrip():
+    params = _params()
+    named, _ = named_flatten(params)
+    compressed = [n for n, p in named.items() if p.ndim > 1]
+    layout = ParamLayout(params, compressed)
+    flat = layout.flatten(params)
+    assert flat.shape == (sum(p.size for p in named.values()),)
+    # compressed block is the prefix
+    assert layout.t_compressed == sum(named[n].size for n in compressed)
+    back = layout.unflatten(flat)
+    for n, p in named_flatten(back)[0].items():
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(named[n]))
+
+
+def test_layout_mask_vector():
+    params = _params()
+    layout = ParamLayout(params, [])
+    mask = np.asarray(layout.mask_vector(lambda n: "bn" not in n))
+    named, _ = named_flatten(params)
+    assert mask.sum() == sum(p.size for n, p in named.items() if "bn" not in n)
+    off, sz = layout.offsets["bn/scale"], layout.sizes["bn/scale"]
+    assert (mask[off:off + sz] == 0).all()
+
+
+def _flat_exchange_fn(dist, engine, mesh):
+    def worker(fg, mem, key):
+        fg = fg[0]
+        mem = jax.tree.map(lambda x: x[0], mem)
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        out, mem = engine.exchange(fg, mem, key, "data", W)
+        return out[None], jax.tree.map(lambda x: x[None], mem)
+
+    return jax.jit(jax.shard_map(
+        worker, mesh=mesh, in_specs=(P("data"), P("data"), P()),
+        out_specs=(P("data"), P("data")), check_vma=False))
+
+
+def _pt_exchange_fn(dist, mesh):
+    def worker(grads, mem, key):
+        grads = jax.tree.map(lambda x: x[0], grads)
+        mem = jax.tree.map(lambda x: x[0], mem)
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        out, mem = dist.exchange(grads, mem, key)
+        return (jax.tree.map(lambda x: x[None], out),
+                jax.tree.map(lambda x: x[None], mem))
+
+    return jax.jit(jax.shard_map(
+        worker, mesh=mesh, in_specs=(P("data"), P("data"), P()),
+        out_specs=(P("data"), P("data")), check_vma=False))
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+@pytest.mark.parametrize("momentum_masking", [False, True])
+def test_flat_matches_per_tensor_exchange(mesh8, nesterov, momentum_masking):
+    """Same grads, deterministic selection -> identical exchanged gradients
+    and memory on both paths, including over multiple steps (error feedback
+    accumulates differently if masking or compensation diverges)."""
+    params = _params()
+    named, _ = named_flatten(params)
+
+    def make(dist_cls=None):
+        comp = DGCCompressor(
+            0.05, memory=DGCSGDMemory(momentum=0.9, nesterov=nesterov,
+                                      momentum_masking=momentum_masking),
+            sample_ratio=1.0)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        return comp, DistributedOptimizer(
+            dgc_sgd(0.1, momentum=0.9), comp, world_size=W)
+
+    comp_f, dist_f = make()
+    comp_p, dist_p = make()
+    layout, engine = dist_f.make_flat(params)
+
+    rng = np.random.RandomState(1)
+    grads_w = {n: jnp.asarray(rng.randn(W, *p.shape), jnp.float32)
+               for n, p in named.items()}
+
+    flat_fn = _flat_exchange_fn(dist_f, engine, mesh8)
+    pt_fn = _pt_exchange_fn(dist_p, mesh8)
+
+    mem_f = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         engine.init_memory())
+    mem_p = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         dist_p.init_memory(params))
+
+    flat_grads_w = jnp.stack([
+        jnp.concatenate([grads_w[n][w].reshape(-1) for n in layout.names])
+        for w in range(W)])
+
+    for step in range(3):
+        key = jax.random.PRNGKey(step)
+        out_f, mem_f = flat_fn(flat_grads_w, mem_f, key)
+        out_p, mem_p = pt_fn(grads_w, mem_p, key)
+        named_out_p, _ = named_flatten(out_p)
+        flat_out_p = jnp.concatenate(
+            [named_out_p[n][0].reshape(-1) for n in layout.names])
+        np.testing.assert_allclose(np.asarray(out_f[0]),
+                                   np.asarray(flat_out_p),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"exchanged grads step {step}")
+        # memory equivalence (flat stores [P] buffers)
+        mmt_p = {n: mem_p["momentums"][n][0] for n in mem_p["momentums"]}
+        flat_mmt_p = jnp.concatenate(
+            [mmt_p[n].reshape(-1) for n in layout.names])
+        np.testing.assert_allclose(np.asarray(mem_f["momentums"][0]),
+                                   np.asarray(flat_mmt_p),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"momentums step {step}")
+        vec_p = {n: mem_p["velocities"][n][0] for n in mem_p["velocities"]}
+        flat_vec_p = jnp.concatenate(
+            [vec_p[n].reshape(-1) for n in layout.names])
+        np.testing.assert_allclose(np.asarray(mem_f["velocities"][0]),
+                                   np.asarray(flat_vec_p),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"velocities step {step}")
+
+
+def test_flat_payload_matches_reference_wire_volume():
+    """The tight payload is exactly sum(num_selects) — the reference's wire
+    size (compression.py:151), no padding inflation."""
+    params, comp, dist = _make_dist(sample_ratio=0.25, ratio=0.01)
+    layout, engine = dist.make_flat(params)
+    expected = sum(a.num_selects for a in comp.attributes.values())
+    assert engine.payload_size == expected
+
+
+def test_flat_sparsify_selects_topk(mesh8):
+    """With deterministic sampling, the flat engine selects exactly the
+    num_selects largest-|.| coordinates of each tensor."""
+    params, comp, dist = _make_dist(sample_ratio=1.0, ratio=0.05)
+    layout, engine = dist.make_flat(params)
+    rng = np.random.RandomState(2)
+    vec = rng.randn(layout.t_compressed).astype(np.float32)
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                         jax.random.PRNGKey(0))
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    for name in layout.compressed_names:
+        a = comp.attributes[name]
+        off = layout.offsets[name]
+        seg = vec[off:off + a.numel]
+        expect = set(off + np.argsort(-np.abs(seg))[:a.num_selects])
+        got = {int(i) for i in idx if off <= i < off + a.numel}
+        assert got == expect, name
+        for i in idx:
+            if off <= i < off + a.numel:
+                assert vals[list(idx).index(i)] == seg[i - off]
+
+
+def test_flat_dense_exchange_psum(mesh8):
+    params = _params()
+    dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=W)
+    layout, engine = dist.make_flat(params)
+    rng = np.random.RandomState(3)
+    g = rng.randn(W, layout.total).astype(np.float32)
+    f = _flat_exchange_fn(dist, engine, mesh8)
+    out, _ = f(jnp.asarray(g), {}, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(out[0]), g.mean(0), rtol=1e-5)
+
+
+def test_vector_wd_mask_matches_tree_mask():
+    """dgc_sgd over one flat buffer with a 0/1 mask vector == dgc_sgd over
+    the pytree with per-leaf boolean masks."""
+    params = _params()
+    named, _ = named_flatten(params)
+    layout = ParamLayout(params, [])
+    rng = np.random.RandomState(4)
+    grads = {n: jnp.asarray(rng.randn(*p.shape), jnp.float32)
+             for n, p in named.items()}
+
+    pred = lambda n: "bn" not in n and "bias" not in n
+    tree_mask = jax.tree_util.tree_map_with_path(
+        lambda path, _: pred("/".join(str(getattr(k, 'key', k))
+                                      for k in path)), params)
+    opt_tree = dgc_sgd(0.1, momentum=0.9, weight_decay=1e-2,
+                       weight_decay_mask=tree_mask)
+    opt_flat = dgc_sgd(0.1, momentum=0.9, weight_decay=1e-2,
+                       weight_decay_mask=layout.mask_vector(pred))
+
+    st_t = opt_tree.init(params)
+    flat_p = layout.flatten(params)
+    st_f = opt_flat.init(flat_p)
+    flat_g = jnp.concatenate([grads[n].reshape(-1) for n in layout.names])
+
+    p_t, p_f = params, flat_p
+    g_named = grads
+    for _ in range(3):
+        upd_t, st_t = opt_tree.update(
+            jax.tree_util.tree_unflatten(
+                named_flatten(params)[1], [g_named[n] for n in named]),
+            st_t, p_t)
+        upd_f, st_f = opt_flat.update(flat_g, st_f, p_f)
+        p_t = jax.tree.map(lambda a, b: a + b, p_t, upd_t)
+        p_f = p_f + upd_f
+        named_t, _ = named_flatten(p_t)
+        flat_t = jnp.concatenate(
+            [named_t[n].reshape(-1) for n in layout.names])
+        np.testing.assert_allclose(np.asarray(p_f), np.asarray(flat_t),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_flat_train_step_smoke(mesh8):
+    """Full flat train step on the CPU mesh: runs, loss finite, params move,
+    and a compress-ratio change rebuild keeps working."""
+    from dgc_tpu.models import resnet20
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+
+    model = resnet20(num_classes=10)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                   train=True)
+    named, _ = named_flatten(v["params"])
+    comp = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9),
+                         warmup_epochs=2)
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    comp.warmup_compress_ratio(0)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4),
+                                comp, world_size=W)
+    setup = make_flat_setup(v, dist)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh8)
+    step = build_train_step(model.apply, dist, mesh8, flat=setup)
+
+    rng = np.random.RandomState(5)
+    images = jnp.asarray(rng.randn(W * 4, 32, 32, 3), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, W * 4), jnp.int32)
+    p0 = np.asarray(state.params)
+    state, m = step(state, images, labels, jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"]))
+    assert int(state.step) == 1
+    assert not np.allclose(p0, np.asarray(state.params))
+
+    # ratio change -> rebuild engine + step, state carries over
+    changed = comp.warmup_compress_ratio(5)
+    assert changed
+    setup2 = make_flat_setup(v, dist)
+    step2 = build_train_step(model.apply, dist, mesh8, flat=setup2)
+    state, m = step2(state, images, labels, jax.random.PRNGKey(1))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_flat_uninitialized_compressor_degrades_to_dense(mesh8):
+    """A DGCCompressor whose initialize() was never called has no attributes:
+    every parameter must fall through to the dense psum block (the per-tensor
+    path's `name in attributes` guard, dgc.py compress)."""
+    params = _params()
+    comp = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9))
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    layout, engine = dist.make_flat(params)
+    assert layout.t_compressed == 0 and engine.payload_size == 0
+    rng = np.random.RandomState(7)
+    g = rng.randn(W, layout.total).astype(np.float32)
+    f = _flat_exchange_fn(dist, engine, mesh8)
+    mem = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                       engine.init_memory())
+    out, _ = f(jnp.asarray(g), mem, jax.random.PRNGKey(0))
+    # dense block applies non-accumulating momentum correction to the average;
+    # on zero-initialized memory step 1 output == the plain average
+    np.testing.assert_allclose(np.asarray(out[0]), g.mean(0), rtol=1e-5)
+
+
+def test_flat_uniform_sampling_exact_for_tiny_tensors():
+    """strided_sample=False with tensors whose numel <= 2/ratio (the
+    sample-everything path): the threshold must come from the exact
+    importance vector, not a with-replacement draw."""
+    params = {"w": jnp.asarray(np.arange(1, 41, dtype=np.float32)
+                               .reshape(5, 8))}
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                         strided_sample=False)
+    comp.initialize([("w", params["w"])])
+    a = comp.attributes["w"]
+    assert a.num_samples == a.numel  # degenerate sample-everything geometry
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=W)
+    layout, engine = dist.make_flat(params)
+    vec = np.arange(1, 41, dtype=np.float32)
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                         jax.random.PRNGKey(3))
+    got = {int(i) for v, i in zip(np.asarray(vals), np.asarray(idx))
+           if i < layout.t_compressed}
+    expect = set(np.argsort(-vec)[:a.num_selects])
+    assert got == expect
+
+
+def test_flat_memory_state_dict_roundtrip():
+    params, comp, dist = _make_dist(sample_ratio=1.0, ratio=0.05)
+    layout, engine = dist.make_flat(params)
+    mem = engine.init_memory()
+    mem = {"momentums": mem["momentums"] + 1.0,
+           "velocities": mem["velocities"] + 2.0}
+    sd = engine.memory_state_dict(mem)
+    assert set(sd) == {"momentums", "velocities"}
+    assert set(sd["momentums"]) == set(layout.names)
+    back = engine.load_memory_state_dict(engine.init_memory(), sd)
+    np.testing.assert_allclose(np.asarray(back["momentums"]),
+                               np.asarray(mem["momentums"]))
+    np.testing.assert_allclose(np.asarray(back["velocities"]),
+                               np.asarray(mem["velocities"]))

@@ -73,15 +73,16 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
+    from dgc_tpu.compression.flat import ParamLayout
     from dgc_tpu.optim import DistributedOptimizer
     from dgc_tpu.parallel import make_mesh
     from dgc_tpu.training import (
-        TrainState,
         build_eval_step,
         build_train_step,
+        make_flat_setup,
+        make_flat_state,
         make_lr_schedule,
         shard_state,
-        with_leading_axis,
     )
     from dgc_tpu.training.checkpoint import CheckpointManager
     from dgc_tpu.utils.config import Config, configs
@@ -152,18 +153,6 @@ def main():
         decay=decay,
         schedule_lr_per_epoch=configs.train.schedule_lr_per_epoch)
 
-    # optimize_bn_separately: BN params get weight_decay 0 (train.py:121-125).
-    # BN params are exactly the 1-D 'scale'/'bias' leaves of flax BatchNorm.
-    wd_mask = None
-    if configs.train.get("optimize_bn_separately", False):
-        wd_mask = jax.tree_util.tree_map_with_path(
-            lambda path, _: not any("BatchNorm" in str(k) for k in path),
-            params)
-
-    printr(f'\n==> creating optimizer "{configs.train.optimizer}"')
-    optimizer = configs.train.optimizer(lr=lr_schedule,
-                                        weight_decay_mask=wd_mask)
-
     printr(f'\n==> creating compression "{configs.train.compression}"')
     if configs.train.dgc:
         printr("\n==> initializing dgc compression")
@@ -174,15 +163,24 @@ def main():
     else:
         compression = configs.train.compression()
 
+    # optimize_bn_separately: BN params get weight_decay 0 (train.py:121-125).
+    # On the flat path this is a per-coordinate 0/1 mask over the [P] buffer;
+    # BN params are exactly the 'BatchNorm' leaves of the flax tree.
+    wd_mask = None
+    if configs.train.get("optimize_bn_separately", False):
+        layout = ParamLayout.for_compressor(params, compression)
+        wd_mask = layout.mask_vector(lambda n: "BatchNorm" not in n)
+
+    printr(f'\n==> creating optimizer "{configs.train.optimizer}"')
+    optimizer = configs.train.optimizer(lr=lr_schedule,
+                                        weight_decay_mask=wd_mask)
+
     dist = DistributedOptimizer(optimizer, compression, axis_name=axis,
                                 world_size=world)
 
-    state = shard_state(TrainState(
-        step=jnp.zeros((), jnp.int32),
-        params=params,
-        opt_state=dist.init(params),
-        memory=with_leading_axis(dist.init_memory(params), world),
-        batch_stats=with_leading_axis(batch_stats, world)), mesh, axis)
+    flat_setup = make_flat_setup(variables, dist)
+    state = shard_state(make_flat_state(variables, dist, flat_setup, world),
+                        mesh, axis)
 
     # resume from checkpoint (reference train.py:152-165)
     ckpt = CheckpointManager(ckpt_dir, keep=3)
@@ -197,7 +195,8 @@ def main():
     else:
         printr("\n==> train from scratch")
 
-    eval_fn = build_eval_step(model.apply, mesh, world, axis=axis)
+    eval_fn = build_eval_step(model.apply, mesh, world, axis=axis,
+                              flat=flat_setup)
 
     def evaluate(state, split="test"):
         meters = {}
@@ -238,9 +237,13 @@ def main():
         if configs.train.dgc:
             rebuild |= compression.warmup_compress_ratio(epoch)
         if rebuild:
+            # ratio change => new static attrs => new engine + re-jit
+            # (reference compression.py:91-107; <= warmup_epochs+1 compiles)
+            flat_setup = make_flat_setup(variables, dist)
             step_fn = build_train_step(model.apply, dist, mesh,
                                        num_batches_per_step=nbps,
-                                       use_dropout=use_dropout)
+                                       use_dropout=use_dropout,
+                                       flat=flat_setup)
 
         ds = dataset["train"]
         t0 = time.time()
