@@ -100,7 +100,7 @@ def main():
     print(f"dense step (flat):      {dense_ms:.3f} ms", file=sys.stderr)
 
     # --- exchange model on the reference fabric ---
-    P_total = dgc_setup.layout.total
+    P_total = dgc_setup.layout.num_params
     payload = dgc_setup.engine.payload_size
     Wf = FABRIC_WORKERS
     dense_wire_ms = (2 * 4 * P_total * (Wf - 1) / Wf) / (

@@ -38,9 +38,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from dgc_tpu.compression.memory import DGCSGDMemory, Memory
+from dgc_tpu.ops import kernels
 from dgc_tpu.utils.pytree import named_flatten, named_unflatten
 
 __all__ = ["ParamLayout", "FlatDGCEngine", "FlatDenseExchange"]
+
+#: block alignment (elements) of the compressed-block boundary and the buffer
+#: tail — multiples of the Pallas f32 tile (8 x 128) so the kernels see
+#: aligned buffers and need no padding copies on the hot path
+_ALIGN = 8 * 128
+
+
+def _round_up(n: int, align: int) -> int:
+    return -(-n // align) * align
 
 
 class ParamLayout:
@@ -48,7 +58,16 @@ class ParamLayout:
 
     Compressed names are packed first so the compressed block is the
     contiguous prefix ``[0, t_compressed)`` and the dense fallback block the
-    suffix — one slice each, no gather.
+    suffix — one slice each, no gather. Both the compressed block and the
+    total are padded up to ``_ALIGN`` with structural zeros; the first gap
+    slot after the real compressed data (``sentinel``) doubles as the scatter
+    sentinel — it always holds 0 in every buffer, so padded payload slots
+    read value 0 and scatters to it are no-ops (SURVEY.md §2.5's
+    zero-contribution tolerance), with no +1-extension copies anywhere.
+
+    The layout depends only on shapes + the compressed-name set, never on
+    the compress ratio — memory buffers stay valid across warm-up ratio
+    changes (reference compression.py:91-107).
     """
 
     def __init__(self, tree, compressed_names: Sequence[str] = ()):
@@ -65,13 +84,26 @@ class ParamLayout:
             raise ValueError(
                 f"flat layout requires a uniform dtype, got {dtypes}")
         self.dtype = dtypes.pop() if dtypes else np.dtype(np.float32)
+        #: number of real (non-padding) parameters
+        self.num_params = sum(self.sizes.values())
+
         self.offsets: Dict[str, int] = {}
         off = 0
-        for n in self.names:
+        for n in compressed:
             self.offsets[n] = off
             off += self.sizes[n]
-        self.total = off
-        self.t_compressed = sum(self.sizes[n] for n in compressed)
+        #: real compressed elements; [t_data, t_compressed) is the zero gap
+        self.t_data = off
+        #: scatter sentinel — always a structural-zero slot (the gap is
+        #: at least one slot wide even when t_data is already aligned)
+        self.t_compressed = _round_up(off + 1, _ALIGN) if compressed else 0
+        self.sentinel = self.t_data
+        off = self.t_compressed
+        for n in dense:
+            self.offsets[n] = off
+            off += self.sizes[n]
+        self.p_data_end = off
+        self.total = _round_up(off, _ALIGN) if off else 0
         # insertion order of `named` (the treedef leaf order), for unflatten
         self._tree_order = list(named)
 
@@ -87,11 +119,20 @@ class ParamLayout:
     # -------------------------------------------------------------- #
 
     def flatten(self, tree) -> jax.Array:
-        """Pytree -> flat [P] (layout order)."""
+        """Pytree -> flat [P] (layout order, structural-zero gaps)."""
+        if not self.names:
+            return jnp.zeros((0,), self.dtype)
         named, _ = named_flatten(tree)
-        return jnp.concatenate(
-            [jnp.ravel(named[n]) for n in self.names]) if self.names else (
-            jnp.zeros((0,), self.dtype))
+        parts = [jnp.ravel(named[n]) for n in self.compressed_names]
+        if self.t_compressed > self.t_data:
+            parts.append(jnp.zeros((self.t_compressed - self.t_data,),
+                                   self.dtype))
+        parts += [jnp.ravel(named[n]) for n in self.names
+                  if n not in set(self.compressed_names)]
+        if self.total > self.p_data_end:
+            parts.append(jnp.zeros((self.total - self.p_data_end,),
+                                   self.dtype))
+        return jnp.concatenate(parts)
 
     def unflatten(self, flat: jax.Array):
         """Flat [P] -> pytree with the original structure."""
@@ -119,19 +160,26 @@ class ParamLayout:
 
 
 class _Bucket(NamedTuple):
-    """Size-bucketed batch of compressed tensors (all static, host-side)."""
-    row_offsets: np.ndarray    # [R] global offset of each tensor
-    numels: np.ndarray         # [R]
-    max_n: int
-    strides: np.ndarray        # [R] sampling stride
-    num_samples: np.ndarray    # [R]
+    """Size-bucketed batch of compressed tensors (all static, host-side).
+
+    Rows are padded to a multiple of 8 (the f32 sublane) and columns to the
+    ladder kernel's block width — padding rows have numel 0 / num_selects 0,
+    so the in-trace row view maps every padded slot to the layout sentinel
+    and nothing is ever selected from them. No device-side padding copies."""
+    rows: int                  # real rows R
+    rows_padded: int           # R8 (multiple of 8)
+    cols: int                  # padded row width (kernel block aligned)
+    row_offsets: np.ndarray    # [R8] global offset of each tensor
+    numels: np.ndarray         # [R8]
+    strides: np.ndarray        # [R8] sampling stride
+    num_samples: np.ndarray    # [R8]
     max_s: int
-    topk_samples: np.ndarray   # [R]
+    topk_samples: np.ndarray   # [R8]
     max_k: int
-    num_selects: np.ndarray    # [R]
+    num_selects: np.ndarray    # [R8]
     max_sel: int
-    adapt: np.ndarray          # [R] bool: run threshold adaptation
-    tight: np.ndarray          # [payload] positions into the [R*max_sel] grid
+    adapt: np.ndarray          # [R8] bool: run threshold adaptation
+    tight: np.ndarray          # [payload] positions into the [R8*max_sel] grid
     payload: int
 
 
@@ -143,6 +191,10 @@ def _build_buckets(attributes, layout: ParamLayout,
     buckets: List[_Bucket] = []
     group: List[str] = []
 
+    def pad8(a, fill):
+        r8 = _round_up(max(len(a), 1), 8)
+        return np.concatenate([a, np.full((r8 - len(a),), fill, a.dtype)])
+
     def flush(group):
         if not group:
             return
@@ -152,18 +204,26 @@ def _build_buckets(attributes, layout: ParamLayout,
         tight = np.concatenate([
             np.arange(r * max_sel, r * max_sel + k, dtype=np.int64)
             for r, k in enumerate(num_selects)])
+        max_n = int(max(a.numel for a in attrs))
         buckets.append(_Bucket(
-            row_offsets=np.array([layout.offsets[n] for n in group], np.int32),
-            numels=np.array([a.numel for a in attrs], np.int32),
-            max_n=int(max(a.numel for a in attrs)),
-            strides=np.array([a.sample_stride for a in attrs], np.int32),
-            num_samples=np.array([a.num_samples for a in attrs], np.int32),
+            rows=len(group),
+            rows_padded=_round_up(len(group), 8),
+            cols=kernels.ladder_cols(max_n),
+            row_offsets=pad8(np.array([layout.offsets[n] for n in group],
+                                      np.int32), layout.sentinel),
+            numels=pad8(np.array([a.numel for a in attrs], np.int32), 0),
+            strides=pad8(np.array([a.sample_stride for a in attrs],
+                                  np.int32), 1),
+            num_samples=pad8(np.array([a.num_samples for a in attrs],
+                                      np.int32), 0),
             max_s=int(max(a.num_samples for a in attrs)),
-            topk_samples=np.array([a.top_k_samples for a in attrs], np.int32),
+            topk_samples=pad8(np.array([a.top_k_samples for a in attrs],
+                                       np.int32), 1),
             max_k=int(max(a.top_k_samples for a in attrs)),
-            num_selects=num_selects,
+            num_selects=pad8(num_selects, 0),
             max_sel=max_sel,
-            adapt=np.array([a.numel > a.num_samples for a in attrs], bool),
+            adapt=pad8(np.array([a.numel > a.num_samples for a in attrs],
+                                bool), False),
             tight=tight,
             payload=int(num_selects.sum()),
         ))
@@ -177,6 +237,32 @@ def _build_buckets(attributes, layout: ParamLayout,
         group.append(n)
     flush(group)
     return buckets
+
+
+def _ladder_adapt(imp_rows, thr, num_selects, adapt_mask, lower,
+                  max_iters: int):
+    """One-pass threshold adaptation for ``resample=True``.
+
+    With resample, the reference's loop only LOWERS the threshold
+    (x lower_bound while too few pass, compression.py:139-149; overflow is
+    resolved by the exact top-k select). The trajectory therefore lives on
+    the static ladder ``thr * lb^i``, and the sequential stopping rule
+    "first i with count >= lo, else max_iters" is a closed-form pick once
+    all ladder counts are known — computed in ONE pass over the rows
+    (Pallas kernel on TPU; its jnp reference elsewhere) instead of one full
+    re-scan per loop iteration."""
+    levels = max_iters + 1
+    if kernels.use_pallas():
+        counts = kernels.ladder_counts(imp_rows, thr, lower, levels)
+    else:
+        counts = kernels.ladder_counts_reference(imp_rows, thr, lower,
+                                                 levels)
+    lo = (lower * num_selects)[:, None]                   # [R, 1]
+    passing = counts.astype(jnp.float32) >= lo            # [R, L]
+    first = jnp.argmax(passing, axis=1).astype(jnp.int32)
+    i_star = jnp.where(jnp.any(passing, axis=1), first, max_iters)
+    adapted = thr * (lower ** i_star.astype(thr.dtype))
+    return jnp.where(adapt_mask, adapted, thr)
 
 
 def _batched_adapt(imp_rows, thr, num_selects, adapt_mask, lower, upper,
@@ -218,7 +304,10 @@ class FlatDGCEngine:
         self.c = compressor
         self.layout = layout
         self.T = layout.t_compressed
-        self.buckets = _build_buckets(compressor.attributes, layout)
+        # ratio >= 1.0 transmits everything dense (per-tensor path's
+        # `compress_ratio < 1.0` guard) — no buckets, no sparse payload
+        self.buckets = (_build_buckets(compressor.attributes, layout)
+                        if compressor.compress_ratio < 1.0 else [])
         #: per-worker wire payload in elements — matches the reference's
         #: sum of per-tensor num_selects exactly (compression.py:151)
         self.payload_size = sum(b.payload for b in self.buckets)
@@ -239,16 +328,18 @@ class FlatDGCEngine:
         return {"momentums": z, "velocities": z}
 
     def _compensate_acc(self, mmt, vec, grad):
-        """Momentum correction + local accumulation (memory.py:50-63)."""
+        """Momentum correction + local accumulation (memory.py:50-63) —
+        the fused single-pass Pallas kernel on TPU, its jnp reference
+        elsewhere (bit-compatible, tests/test_kernels.py)."""
         m = self._mem
         if m is None:
             return grad, mmt, vec
-        if m.nesterov:
-            mmt = (mmt + grad) * m.momentum
-            vec = vec + mmt + grad
+        if kernels.use_pallas() and grad.shape[0] > 0:
+            mmt, vec = kernels.fused_compensate(grad, mmt, vec, m.momentum,
+                                                m.nesterov)
         else:
-            mmt = m.momentum * mmt + grad
-            vec = vec + mmt
+            mmt, vec = kernels.fused_compensate_reference(
+                grad, mmt, vec, m.momentum, m.nesterov)
         return vec, mmt, vec
 
     def _compensate_dense(self, mmt, grad):
@@ -271,20 +362,25 @@ class FlatDGCEngine:
         """Sampled-top-k selection over the compressed block [T].
 
         Returns tight ``(values, indices)`` of length ``payload_size``;
-        padded/invalid slots carry (0.0, T) — index T is the sentinel slot,
-        dropped by every consumer (SURVEY.md §2.5 tolerates zero/duplicate
-        contributions under scatter-add).
+        padded/invalid slots carry (0.0, sentinel) — the sentinel is the
+        always-zero gap slot after the real compressed data, so scatters to
+        it are no-ops (SURVEY.md §2.5 tolerates zero/duplicate
+        contributions under scatter-add) and no +1-extension copies are
+        needed anywhere.
         """
-        T = self.T
+        lay = self.layout
+        S = lay.sentinel
         if not self.buckets:
             return (jnp.zeros((0,), vec_c.dtype), jnp.zeros((0,), jnp.int32))
-        imp_ext = jnp.concatenate(
-            [jnp.abs(vec_c), jnp.full((1,), -1.0, vec_c.dtype)])
-        val_ext = jnp.concatenate([vec_c, jnp.zeros((1,), vec_c.dtype)])
+        # importance: |velocity| on real coords, -1 on the gap (fused select,
+        # no copy); values read straight from vec_c — the gap holds 0
+        coord = jnp.arange(lay.t_compressed, dtype=jnp.int32)
+        imp_full = jnp.where(coord < lay.t_data, jnp.abs(vec_c),
+                             jnp.full((), -1.0, vec_c.dtype))
         out_v, out_i = [], []
         for bi, b in enumerate(self.buckets):
             k = jax.random.fold_in(key, bi)
-            R = b.row_offsets.shape[0]
+            R8 = b.rows_padded
             row_off = jnp.asarray(b.row_offsets)[:, None]
             numels = jnp.asarray(b.numels)[:, None]
 
@@ -295,19 +391,19 @@ class FlatDGCEngine:
                 strides = jnp.asarray(b.strides)[:, None]
                 # random phase in [0, stride) per row; stride-1 rows (the
                 # sample-everything degenerate path) get phase 0 = exact
-                u = jax.random.uniform(k, (R, 1))
+                u = jax.random.uniform(k, (R8, 1))
                 phase = jnp.floor(u * strides).astype(jnp.int32)
                 pos = phase + s_idx * strides
             else:
-                u = jax.random.uniform(k, (R, b.max_s))
+                u = jax.random.uniform(k, (R8, b.max_s))
                 pos = jnp.floor(u * numels).astype(jnp.int32)
                 # rows sampling everything must sample exactly, not with
                 # replacement (per-tensor path's numel==num_samples branch,
                 # dgc.py sparsify)
                 exact = jnp.asarray(b.num_samples)[:, None] >= numels
                 pos = jnp.where(exact, jnp.minimum(s_idx, numels - 1), pos)
-            gpos = jnp.where(s_valid, row_off + pos, T)
-            samples = imp_ext[gpos]                          # [R, maxS]
+            gpos = jnp.where(s_valid, row_off + pos, S)
+            samples = imp_full[gpos]                         # [R8, maxS]
 
             # --- per-row sampled threshold (compression.py:123) ---
             sorted_s = jax.lax.top_k(samples, b.max_k)[0]
@@ -315,19 +411,28 @@ class FlatDGCEngine:
                 sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
                 axis=1)[:, 0]
 
-            # --- batched row view [R, maxN], generated on the fly ---
-            col = jnp.arange(b.max_n, dtype=jnp.int32)[None, :]
+            # --- batched row view [R8, cols], generated on the fly;
+            #     rows/cols padded to the kernel block, all padding -> S ---
+            col = jnp.arange(b.cols, dtype=jnp.int32)[None, :]
             in_row = col < numels
-            rmap = jnp.where(in_row, row_off + col, T)
-            imp_rows = imp_ext[rmap]                         # [R, maxN]
+            rmap = jnp.where(in_row, row_off + col, S)
+            imp_rows = imp_full[rmap]                        # [R8, cols]
 
             # --- bounded threshold adaptation (compression.py:128-149) ---
             if self.c.max_adaptation_iters > 0 and b.adapt.any():
-                thr = _batched_adapt(
-                    imp_rows, thr, jnp.asarray(b.num_selects, jnp.float32),
-                    jnp.asarray(b.adapt), self.c.compress_lower_bound,
-                    self.c.compress_upper_bound, self.c.max_adaptation_iters,
-                    self.c.resample)
+                if self.c.resample:
+                    thr = _ladder_adapt(
+                        imp_rows, thr,
+                        jnp.asarray(b.num_selects, jnp.float32),
+                        jnp.asarray(b.adapt), self.c.compress_lower_bound,
+                        self.c.max_adaptation_iters)
+                else:
+                    thr = _batched_adapt(
+                        imp_rows, thr,
+                        jnp.asarray(b.num_selects, jnp.float32),
+                        jnp.asarray(b.adapt), self.c.compress_lower_bound,
+                        self.c.compress_upper_bound,
+                        self.c.max_adaptation_iters, self.c.resample)
 
             # --- fixed-size selection (ops.select_by_threshold semantics) ---
             scores = jnp.where(imp_rows >= thr[:, None], imp_rows,
@@ -336,8 +441,8 @@ class FlatDGCEngine:
             slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
             valid = (top_scores >= 0) & (
                 slot < jnp.asarray(b.num_selects)[:, None])
-            gidx = jnp.where(valid, row_off + cols.astype(jnp.int32), T)
-            vals = val_ext[gidx]                             # 0.0 at sentinel
+            gidx = jnp.where(valid, row_off + cols.astype(jnp.int32), S)
+            vals = vec_c[gidx]                               # 0.0 at sentinel
 
             tight = jnp.asarray(b.tight)
             out_v.append(vals.reshape(-1)[tight])
@@ -359,6 +464,25 @@ class FlatDGCEngine:
         ``name in attributes`` guard."""
         T, P = self.T, self.layout.total
         m = self._mem
+        if m is not None and m.gradient_clipping is not None:
+            raise NotImplementedError(
+                "per-tensor gradient clipping requires the per-tensor "
+                "path: build the train step without flat= (it uses "
+                "DistributedOptimizer.exchange per tensor)")
+
+        # ratio >= 1.0 (or nothing initialized): everything dense, with the
+        # per-tensor path's non-accumulating correction (dgc.py compress
+        # guard `compress_ratio < 1.0 and name in attributes`)
+        if T == 0 or self.c.compress_ratio >= 1.0:
+            g_w = (flat_grad.astype(jnp.float16) if self.c.fp16_values
+                   else flat_grad)
+            avg = jax.lax.psum(g_w, axis_name).astype(
+                flat_grad.dtype) / world_size
+            if m is None:
+                return avg, mem
+            out, md = self._compensate_dense(mem["momentums"], avg)
+            return out, {"momentums": md, "velocities": mem["velocities"]}
+
         gc, gd = flat_grad[:T], flat_grad[T:]
         if m is not None:
             mmt, vec = mem["momentums"], mem["velocities"]
@@ -368,29 +492,26 @@ class FlatDGCEngine:
 
         # --- compressed block: compensate -> sparsify -> mask -> gather ---
         if m is not None:
-            if m.gradient_clipping is not None:
-                raise NotImplementedError(
-                    "per-tensor gradient clipping requires the per-tensor "
-                    "path: build the train step without flat= (it uses "
-                    "DistributedOptimizer.exchange per tensor)")
             comp, mc, vc = self._compensate_acc(mc, vc, gc)
         else:
             comp = gc
         values, indices = self.sparsify(comp, key)
         if m is not None:
-            vc = vc.at[indices].set(0.0, mode="drop")
+            # the sentinel is a structural-zero slot, so zeroing it is a
+            # no-op — no drop mode / bounds games needed
+            vc = vc.at[indices].set(0.0)
             if m.momentum_masking:
-                mc = mc.at[indices].set(0.0, mode="drop")
+                mc = mc.at[indices].set(0.0)
 
         wire_values = (values.astype(jnp.float16)
                        if self.c.fp16_values else values)
         g_values = jax.lax.all_gather(wire_values, axis_name)  # [W, payload]
         g_indices = jax.lax.all_gather(indices, axis_name)
 
-        acc = jnp.zeros((T + 1,), flat_grad.dtype)
+        acc = jnp.zeros((T,), flat_grad.dtype)
         acc = acc.at[g_indices.reshape(-1)].add(
             g_values.reshape(-1).astype(flat_grad.dtype))
-        out_c = acc[:T] / world_size      # hvd.Average (compression.py:192-193)
+        out_c = acc / world_size          # hvd.Average (compression.py:192-193)
 
         # --- dense fallback block: one psum + average + correction ---
         if P > T:
@@ -426,19 +547,20 @@ class FlatDGCEngine:
 
     def load_memory_state_dict(self, mem: Dict, saved: Optional[Dict]) -> Dict:
         """Per-name saved buffers -> flat memory, merging by name
-        (reference memory.py:82-88)."""
+        (reference memory.py:82-88). Gap slots stay zero."""
         if not mem or saved is None:
             return mem
-        mmt = self.layout.unflatten_named(mem["momentums"], keep_1d=True)
-        vec = self.layout.unflatten_named(mem["velocities"], keep_1d=True)
-        for n in mmt:
-            if n in saved["momentums"]:
-                mmt[n] = jnp.asarray(saved["momentums"][n]).reshape(-1)
-                vec[n] = jnp.asarray(saved["velocities"][n]).reshape(-1)
-        return {
-            "momentums": jnp.concatenate([mmt[n] for n in self.layout.names]),
-            "velocities": jnp.concatenate([vec[n] for n in self.layout.names]),
-        }
+        lay = self.layout
+        out = {}
+        for key in ("momentums", "velocities"):
+            flat = mem[key]
+            for n in lay.names:
+                if n in saved[key]:
+                    piece = jnp.asarray(saved[key][n]).reshape(-1)
+                    flat = jax.lax.dynamic_update_slice(
+                        flat, piece.astype(flat.dtype), (lay.offsets[n],))
+            out[key] = flat
+        return out
 
 
 class FlatDenseExchange:

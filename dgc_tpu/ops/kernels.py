@@ -1,0 +1,212 @@
+"""Pallas TPU kernels for the compression hot path.
+
+SURVEY.md §7 item 6: the reference leans on fused CUDA kernels for its hot
+ops (`topk`, `index_put_`, elementwise momentum updates — dgc/memory.py:50-77,
+dgc/compression.py:109-153); the TPU-native equivalents are Pallas kernels
+over the flat HBM-resident buffers of ``dgc_tpu.compression.flat``.
+
+Shipped kernels (each with a jnp reference implementation it must match
+bitwise — tested in tests/test_kernels.py):
+
+* :func:`fused_compensate` — momentum correction + local accumulation
+  (``mmt = m*mmt + g; vec += mmt``, nesterov variant) in ONE pass over HBM:
+  reads (grad, mmt, vec), writes (mmt', vec') tile by tile through VMEM.
+  The jnp version relies on XLA fusing 2-3 elementwise ops; the kernel makes
+  the single-pass guarantee explicit and holds for any [P] size via grid
+  chunking.
+
+* :func:`ladder_counts` — the threshold-adaptation counts: for a threshold
+  ladder ``thr * lb^i`` (i = 0..L), count per row how many elements pass each
+  level, in ONE pass over the row view. The reference's adaptation loop
+  (compression.py:128-149) re-scans the tensor once per iteration (≤ 10
+  scans); counts for the whole ladder make the final threshold a closed-form
+  pick (see ``flat.FlatDGCEngine``).
+
+Kernels run compiled on TPU and in interpreter mode elsewhere (CPU tests);
+``use_pallas()`` picks automatically.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_compensate", "fused_compensate_reference",
+           "ladder_counts", "ladder_counts_reference", "use_pallas"]
+
+_LANE = 128          # TPU lane width
+_SUBLANE = 8         # f32 sublane
+_CHUNK_ROWS = 512    # rows of 128 lanes per grid step (256 KB/buffer)
+
+
+def use_pallas() -> bool:
+    """Compiled Pallas only on TPU backends; interpret elsewhere."""
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return not use_pallas()
+
+
+# ------------------------------------------------------------------ #
+# fused momentum-correction compensate                               #
+# ------------------------------------------------------------------ #
+
+def fused_compensate_reference(grad, mmt, vec, momentum: float,
+                               nesterov: bool):
+    """jnp reference (the algorithm contract, reference memory.py:50-63)."""
+    if nesterov:
+        mmt = (mmt + grad) * momentum
+        vec = vec + mmt + grad
+    else:
+        mmt = momentum * mmt + grad
+        vec = vec + mmt
+    return mmt, vec
+
+
+def _compensate_kernel(g_ref, m_ref, v_ref, om_ref, ov_ref, *, momentum,
+                       nesterov):
+    g = g_ref[:]
+    if nesterov:
+        m = (m_ref[:] + g) * momentum
+        ov_ref[:] = v_ref[:] + m + g
+    else:
+        m = momentum * m_ref[:] + g
+        ov_ref[:] = v_ref[:] + m
+    om_ref[:] = m
+
+
+@functools.partial(jax.jit, static_argnames=("momentum", "nesterov"))
+def fused_compensate(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
+                     momentum: float, nesterov: bool = False
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Single-pass ``(mmt', vec')`` over flat [P] buffers.
+
+    Buffers whose length is a multiple of 8*128 (the ``ParamLayout``
+    alignment) run copy-free: reshape to [rows, 128] is a view, the grid's
+    ragged last block is masked by Mosaic. Other lengths (direct callers,
+    tests) pay one pad copy."""
+    n = grad.shape[0]
+    pad = (-n) % (_SUBLANE * _LANE)
+    if pad:
+        grad = jnp.concatenate([grad, jnp.zeros((pad,), grad.dtype)])
+        mmt = jnp.concatenate([mmt, jnp.zeros((pad,), mmt.dtype)])
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+    rows = (n + pad) // _LANE
+    shape2d = (rows, _LANE)
+    g2, m2, v2 = (x.reshape(shape2d) for x in (grad, mmt, vec))
+
+    block_rows = min(_CHUNK_ROWS, rows)
+    grid = pl.cdiv(rows, block_rows)
+    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    om, ov = pl.pallas_call(
+        functools.partial(_compensate_kernel, momentum=momentum,
+                          nesterov=nesterov),
+        grid=(grid,),
+        out_shape=(jax.ShapeDtypeStruct(shape2d, grad.dtype),
+                   jax.ShapeDtypeStruct(shape2d, grad.dtype)),
+        in_specs=[spec, spec, spec],
+        out_specs=(spec, spec),
+        interpret=_interpret(),
+    )(g2, m2, v2)
+    om, ov = om.reshape(-1), ov.reshape(-1)
+    return (om[:n], ov[:n]) if pad else (om, ov)
+
+
+# ------------------------------------------------------------------ #
+# threshold-ladder counts                                            #
+# ------------------------------------------------------------------ #
+
+def ladder_counts_reference(imp_rows: jax.Array, thr: jax.Array,
+                            lower_bound: float, levels: int) -> jax.Array:
+    """jnp reference: ``counts[r, i] = sum(imp_rows[r] >= thr[r] * lb**i)``.
+
+    ``imp_rows`` is the padded [R, maxN] row view (padding = -1, never
+    counted since thresholds are >= 0). One compare+reduce per level (XLA
+    fuses the sibling reductions over the shared read) — no [R, maxN, L]
+    broadcast, so memory stays O(R * maxN)."""
+    cols = [jnp.sum(imp_rows >= (lower_bound ** i) * thr[:, None], axis=1,
+                    dtype=jnp.int32) for i in range(levels)]
+    return jnp.stack(cols, axis=1)                        # [R, L]
+
+
+#: column chunk per grid step: 8 rows x 128K cols x 4 B = 4 MB VMEM
+_LADDER_COL_CHUNK = 128 * 1024
+
+
+def ladder_cols(max_n: int) -> int:
+    """Padded row width the ladder kernel requires: lane-aligned, and a
+    multiple of the column chunk once chunking kicks in (ragged column
+    blocks would read unspecified values into the counts). The engine bakes
+    this width into its sentinel row maps so no device-side padding copy is
+    ever made."""
+    cols = _round_up(max_n, _LANE)
+    if cols > _LADDER_COL_CHUNK:
+        cols = _round_up(cols, _LADDER_COL_CHUNK)
+    return cols
+
+
+def _round_up(n: int, align: int) -> int:
+    return -(-n // align) * align
+
+
+def _ladder_kernel(imp_ref, thr_ref, out_ref, *, lower_bound, levels):
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    imp = imp_ref[:]                                      # [8, chunk]
+    t = thr_ref[:]                                        # [8, 1]
+    partial = jnp.stack(
+        [jnp.sum((imp >= (lower_bound ** i) * t).astype(jnp.int32), axis=1)
+         for i in range(levels)], axis=1)                 # [8, L]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (8, _LANE), 1)
+    padded = jnp.where(lane < levels,
+                       jnp.pad(partial, ((0, 0), (0, _LANE - levels))),
+                       0)
+    out_ref[:] = out_ref[:] + padded
+
+
+@functools.partial(jax.jit, static_argnames=("lower_bound", "levels"))
+def ladder_counts(imp_rows: jax.Array, thr: jax.Array, lower_bound: float,
+                  levels: int) -> jax.Array:
+    """Per-row pass counts for the whole threshold ladder, one HBM read.
+
+    Grid: (row blocks of 8) x (column chunks); the [8, 128]-int32 output
+    block is revisited across column chunks and accumulated. Inputs that
+    are not (8, ladder_cols)-aligned pay one pad copy — the engine passes
+    pre-aligned sentinel views so the hot path never does."""
+    assert levels <= _LANE
+    R, maxN = imp_rows.shape
+    rpad = (-R) % _SUBLANE
+    cpad = ladder_cols(maxN) - maxN
+    if rpad or cpad:
+        imp_rows = jnp.pad(imp_rows, ((0, rpad), (0, cpad)),
+                           constant_values=-1.0)
+    if rpad:
+        thr = jnp.pad(thr, (0, rpad))
+    R8, cols = R + rpad, maxN + cpad
+    chunk = min(_LADDER_COL_CHUNK, cols)
+    out = pl.pallas_call(
+        functools.partial(_ladder_kernel, lower_bound=lower_bound,
+                          levels=levels),
+        grid=(R8 // _SUBLANE, cols // chunk),
+        out_shape=jax.ShapeDtypeStruct((R8, _LANE), jnp.int32),
+        in_specs=[
+            pl.BlockSpec((_SUBLANE, chunk), lambda r, c: (r, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANE, 1), lambda r, c: (r, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANE, _LANE), lambda r, c: (r, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(imp_rows, thr.reshape(-1, 1))
+    return out[:R, :levels]

@@ -103,6 +103,15 @@ class CheckpointManager:
             lambda x: np.asarray(jax.device_get(x)), template)
         try:
             state = self._ckptr.restore(path, host_template)
+            # orbax only validates tree STRUCTURE; stale checkpoints from a
+            # different flat layout restore silently with on-disk shapes —
+            # reject those too
+            mismatch = jax.tree.map(
+                lambda a, b: np.shape(a) != np.shape(b), state,
+                host_template)
+            if any(jax.tree.leaves(mismatch)):
+                raise ValueError("leaf shapes differ from the current "
+                                 "state layout")
         except ValueError as e:
             # on-disk structure from an older/incompatible state layout
             # (e.g. per-tensor vs flat buffers): train from scratch rather

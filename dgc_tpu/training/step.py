@@ -117,11 +117,11 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     With ``flat`` (a :class:`FlatSetup`), the state must come from
     :func:`make_flat_state` and the whole pipeline runs over flat HBM buffers
     (fused exchange, two collectives per step) — the default fast path.
+
+    Both paths share ONE worker implementation, parameterized only on how
+    params/grads/stats are represented and which update entrypoint runs —
+    so their numerics cannot drift apart.
     """
-    if flat is not None:
-        return _build_flat_train_step(apply_fn, dist_opt, mesh, flat,
-                                      num_batches_per_step, use_dropout,
-                                      donate)
     loss_fn = make_loss_fn(apply_fn)
     world = dist_opt.world_size
     axis = dist_opt.axis_name
@@ -129,10 +129,30 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     r_nbps = 1.0 / nbps
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+    if flat is not None:
+        layout, stats_layout, engine = flat
+        unpack_params = layout.unflatten
+        unpack_stats = stats_layout.unflatten   # empty layout -> {} and back
+        pack_grads = layout.flatten
+        pack_stats = stats_layout.flatten
+
+        def do_update(grads, state, memory, key):
+            upd, opt_state, memory = dist_opt.update_flat(
+                grads, state.opt_state, state.params, memory, key, engine)
+            return state.params + upd, opt_state, memory
+    else:
+        unpack_params = unpack_stats = pack_grads = pack_stats = (
+            lambda x: x)
+
+        def do_update(grads, state, memory, key):
+            upd, opt_state, memory = dist_opt.update(
+                grads, state.opt_state, state.params, memory, key)
+            return optax.apply_updates(state.params, upd), opt_state, memory
+
     def worker(state: TrainState, images, labels, key):
-        params = state.params
+        params = unpack_params(state.params)
         memory = _squeeze0(state.memory)
-        batch_stats = _squeeze0(state.batch_stats)
+        packed_stats = _squeeze0(state.batch_stats)
 
         widx = jax.lax.axis_index(axis)
         key = jax.random.fold_in(key, widx)
@@ -142,108 +162,31 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         mb_labels = labels.reshape((nbps, -1))
 
         def micro(carry, mb):
-            gsum, stats, losssum, i = carry
+            gsum, pstats, losssum, i = carry
             imgs, lbls = mb
             dk = (jax.random.fold_in(dropout_key, i) if use_dropout else None)
-            (lval, new_stats), grads = grad_fn(params, stats, imgs, lbls,
-                                               r_nbps, dk)
-            gsum = jax.tree.map(jnp.add, gsum, grads)
-            return (gsum, new_stats, losssum + lval, i + 1), None
+            (lval, new_stats), grads = grad_fn(params, unpack_stats(pstats),
+                                               imgs, lbls, r_nbps, dk)
+            gsum = jax.tree.map(jnp.add, gsum, pack_grads(grads))
+            return (gsum, pack_stats(new_stats), losssum + lval, i + 1), None
 
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        (grads, batch_stats, loss, _), _ = jax.lax.scan(
-            micro, (zeros, batch_stats, jnp.zeros((), jnp.float32),
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        (grads, packed_stats, loss, _), _ = jax.lax.scan(
+            micro, (zeros, packed_stats, jnp.zeros((), jnp.float32),
                     jnp.zeros((), jnp.int32)),
             (mb_images, mb_labels))
 
-        updates, opt_state, memory = dist_opt.update(
-            grads, state.opt_state, params, memory, sparsify_key)
-        params = optax.apply_updates(params, updates)
+        new_params, opt_state, memory = do_update(grads, state, memory,
+                                                  sparsify_key)
 
         mean_loss = jax.lax.psum(loss, axis) / world
 
         new_state = TrainState(
             step=state.step + 1,
-            params=params,
+            params=new_params,
             opt_state=opt_state,
             memory=_expand0(memory),
-            batch_stats=_expand0(batch_stats),
-        )
-        return new_state, {"loss": mean_loss}
-
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
-    def step_fn(state, images, labels, key):
-        specs = state_specs(state, axis)
-        sharded = jax.shard_map(
-            worker, mesh=mesh,
-            in_specs=(specs, P(axis), P(axis), P()),
-            out_specs=(specs, {"loss": P()}),
-            check_vma=False)
-        return sharded(state, images, labels, key)
-
-    return step_fn
-
-
-def _build_flat_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
-                           mesh: Mesh, flat: FlatSetup,
-                           num_batches_per_step: int, use_dropout: bool,
-                           donate: bool):
-    """Flat-buffer train step: identical numerics to the per-tensor step, but
-    params/opt/memory are [P]-sized buffers and the exchange is the fused
-    engine (two all_gathers + one psum per step, SURVEY.md §7 hard-parts #3).
-    """
-    loss_fn = make_loss_fn(apply_fn)
-    layout, stats_layout, engine = flat
-    world = dist_opt.world_size
-    axis = dist_opt.axis_name
-    nbps = num_batches_per_step
-    r_nbps = 1.0 / nbps
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-    has_stats = stats_layout.total > 0
-
-    def worker(state: TrainState, images, labels, key):
-        flat_params = state.params
-        params = layout.unflatten(flat_params)
-        memory = _squeeze0(state.memory)
-        flat_stats = _squeeze0(state.batch_stats)
-
-        widx = jax.lax.axis_index(axis)
-        key = jax.random.fold_in(key, widx)
-        dropout_key, sparsify_key = jax.random.split(key)
-
-        mb_images = images.reshape((nbps, -1) + images.shape[1:])
-        mb_labels = labels.reshape((nbps, -1))
-
-        def micro(carry, mb):
-            gsum, fstats, losssum, i = carry
-            imgs, lbls = mb
-            dk = (jax.random.fold_in(dropout_key, i) if use_dropout else None)
-            stats = stats_layout.unflatten(fstats) if has_stats else {}
-            (lval, new_stats), grads = grad_fn(params, stats, imgs, lbls,
-                                               r_nbps, dk)
-            gsum = gsum + layout.flatten(grads)
-            fstats = (stats_layout.flatten(new_stats) if has_stats
-                      else fstats)
-            return (gsum, fstats, losssum + lval, i + 1), None
-
-        (flat_grads, flat_stats, loss, _), _ = jax.lax.scan(
-            micro, (jnp.zeros_like(flat_params), flat_stats,
-                    jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-            (mb_images, mb_labels))
-
-        updates, opt_state, memory = dist_opt.update_flat(
-            flat_grads, state.opt_state, flat_params, memory, sparsify_key,
-            engine)
-        flat_params = flat_params + updates
-
-        mean_loss = jax.lax.psum(loss, axis) / world
-
-        new_state = TrainState(
-            step=state.step + 1,
-            params=flat_params,
-            opt_state=opt_state,
-            memory=_expand0(memory),
-            batch_stats=_expand0(flat_stats),
+            batch_stats=_expand0(packed_stats),
         )
         return new_state, {"loss": mean_loss}
 

@@ -55,9 +55,18 @@ def test_layout_roundtrip():
     compressed = [n for n, p in named.items() if p.ndim > 1]
     layout = ParamLayout(params, compressed)
     flat = layout.flatten(params)
-    assert flat.shape == (sum(p.size for p in named.values()),)
-    # compressed block is the prefix
-    assert layout.t_compressed == sum(named[n].size for n in compressed)
+    assert flat.shape == (layout.total,)
+    assert layout.num_params == sum(p.size for p in named.values())
+    # compressed block is the aligned prefix; the gap holds the sentinel
+    t_data = sum(named[n].size for n in compressed)
+    assert layout.t_data == t_data
+    assert layout.sentinel == t_data
+    assert layout.t_compressed >= t_data + 1
+    assert layout.t_compressed % 1024 == 0 and layout.total % 1024 == 0
+    # gaps are structural zeros
+    fl = np.asarray(flat)
+    assert (fl[layout.t_data:layout.t_compressed] == 0).all()
+    assert (fl[layout.p_data_end:] == 0).all()
     back = layout.unflatten(flat)
     for n, p in named_flatten(back)[0].items():
         np.testing.assert_array_equal(np.asarray(p), np.asarray(named[n]))
@@ -134,36 +143,36 @@ def test_flat_matches_per_tensor_exchange(mesh8, nesterov, momentum_masking):
     mem_p = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
                          dist_p.init_memory(params))
 
-    flat_grads_w = jnp.stack([
-        jnp.concatenate([grads_w[n][w].reshape(-1) for n in layout.names])
-        for w in range(W)])
+    from dgc_tpu.utils.pytree import named_unflatten
+
+    def worker_tree(w):
+        return named_unflatten({n: grads_w[n][w] for n in named},
+                               named_flatten(params)[1])
+
+    flat_grads_w = jnp.stack(
+        [layout.flatten(worker_tree(w)) for w in range(W)])
 
     for step in range(3):
         key = jax.random.PRNGKey(step)
         out_f, mem_f = flat_fn(flat_grads_w, mem_f, key)
         out_p, mem_p = pt_fn(grads_w, mem_p, key)
         named_out_p, _ = named_flatten(out_p)
-        flat_out_p = jnp.concatenate(
-            [named_out_p[n][0].reshape(-1) for n in layout.names])
-        np.testing.assert_allclose(np.asarray(out_f[0]),
-                                   np.asarray(flat_out_p),
-                                   rtol=1e-5, atol=1e-6,
-                                   err_msg=f"exchanged grads step {step}")
-        # memory equivalence (flat stores [P] buffers)
-        mmt_p = {n: mem_p["momentums"][n][0] for n in mem_p["momentums"]}
-        flat_mmt_p = jnp.concatenate(
-            [mmt_p[n].reshape(-1) for n in layout.names])
-        np.testing.assert_allclose(np.asarray(mem_f["momentums"][0]),
-                                   np.asarray(flat_mmt_p),
-                                   rtol=1e-5, atol=1e-6,
-                                   err_msg=f"momentums step {step}")
-        vec_p = {n: mem_p["velocities"][n][0] for n in mem_p["velocities"]}
-        flat_vec_p = jnp.concatenate(
-            [vec_p[n].reshape(-1) for n in layout.names])
-        np.testing.assert_allclose(np.asarray(mem_f["velocities"][0]),
-                                   np.asarray(flat_vec_p),
-                                   rtol=1e-5, atol=1e-6,
-                                   err_msg=f"velocities step {step}")
+        named_out_f = layout.unflatten_named(out_f[0])
+        for n in layout.names:
+            np.testing.assert_allclose(
+                np.asarray(named_out_f[n]).reshape(-1),
+                np.asarray(named_out_p[n][0]).reshape(-1),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"exchanged grads step {step} {n}")
+        # memory equivalence (flat stores [P] buffers; compare per name)
+        for mkey in ("momentums", "velocities"):
+            named_m_f = layout.unflatten_named(mem_f[mkey][0], keep_1d=True)
+            for n in layout.names:
+                np.testing.assert_allclose(
+                    np.asarray(named_m_f[n]),
+                    np.asarray(mem_p[mkey][n][0]).reshape(-1),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"{mkey} step {step} {n}")
 
 
 def test_flat_payload_matches_reference_wire_volume():
@@ -181,7 +190,8 @@ def test_flat_sparsify_selects_topk(mesh8):
     params, comp, dist = _make_dist(sample_ratio=1.0, ratio=0.05)
     layout, engine = dist.make_flat(params)
     rng = np.random.RandomState(2)
-    vec = rng.randn(layout.t_compressed).astype(np.float32)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:layout.t_data] = rng.randn(layout.t_data).astype(np.float32)
     vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
                                          jax.random.PRNGKey(0))
     vals, idx = np.asarray(vals), np.asarray(idx)
@@ -227,10 +237,12 @@ def test_vector_wd_mask_matches_tree_mask():
     opt_flat = dgc_sgd(0.1, momentum=0.9, weight_decay=1e-2,
                        weight_decay_mask=layout.mask_vector(pred))
 
+    from dgc_tpu.utils.pytree import named_unflatten
     st_t = opt_tree.init(params)
     flat_p = layout.flatten(params)
     st_f = opt_flat.init(flat_p)
-    flat_g = jnp.concatenate([grads[n].reshape(-1) for n in layout.names])
+    flat_g = layout.flatten(
+        named_unflatten(dict(grads), named_flatten(params)[1]))
 
     p_t, p_f = params, flat_p
     g_named = grads
@@ -243,10 +255,11 @@ def test_vector_wd_mask_matches_tree_mask():
         p_t = jax.tree.map(lambda a, b: a + b, p_t, upd_t)
         p_f = p_f + upd_f
         named_t, _ = named_flatten(p_t)
-        flat_t = jnp.concatenate(
-            [named_t[n].reshape(-1) for n in layout.names])
-        np.testing.assert_allclose(np.asarray(p_f), np.asarray(flat_t),
-                                   rtol=1e-6, atol=1e-7)
+        named_f = layout.unflatten_named(p_f)
+        for n in layout.names:
+            np.testing.assert_allclose(np.asarray(named_f[n]).reshape(-1),
+                                       np.asarray(named_t[n]).reshape(-1),
+                                       rtol=1e-6, atol=1e-7)
 
 
 def test_flat_train_step_smoke(mesh8):
@@ -322,13 +335,38 @@ def test_flat_uniform_sampling_exact_for_tiny_tensors():
     assert a.num_samples == a.numel  # degenerate sample-everything geometry
     dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=W)
     layout, engine = dist.make_flat(params)
-    vec = np.arange(1, 41, dtype=np.float32)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:40] = np.arange(1, 41, dtype=np.float32)
     vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
                                          jax.random.PRNGKey(3))
     got = {int(i) for v, i in zip(np.asarray(vals), np.asarray(idx))
-           if i < layout.t_compressed}
+           if i < layout.t_data}
     expect = set(np.argsort(-vec)[:a.num_selects])
     assert got == expect
+
+
+def test_flat_ratio_one_routes_dense(mesh8):
+    """compress_ratio == 1.0 must transmit everything dense with the
+    per-tensor path's non-accumulating correction (dgc.py's
+    `compress_ratio < 1.0` guard) — no sparse payload at all."""
+    params = _params()
+    named, _ = named_flatten(params)
+    comp = DGCCompressor(1.0, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=W)
+    layout, engine = dist.make_flat(params)
+    assert engine.payload_size == 0
+    rng = np.random.RandomState(11)
+    g = rng.randn(W, layout.total).astype(np.float32)
+    f = _flat_exchange_fn(dist, engine, mesh8)
+    mem = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                       engine.init_memory())
+    out, mem2 = f(jnp.asarray(g), mem, jax.random.PRNGKey(0))
+    # zero-initialized memory, step 1: out == momentum-corrected average
+    # == 0.9*0 + mean(g)
+    np.testing.assert_allclose(np.asarray(out[0]), g.mean(0), rtol=1e-5)
+    # velocities untouched on the dense path (memory.py:64-70)
+    np.testing.assert_array_equal(np.asarray(mem2["velocities"][0]), 0)
 
 
 def test_flat_memory_state_dict_roundtrip():
@@ -341,7 +379,10 @@ def test_flat_memory_state_dict_roundtrip():
     assert set(sd) == {"momentums", "velocities"}
     assert set(sd["momentums"]) == set(layout.names)
     back = engine.load_memory_state_dict(engine.init_memory(), sd)
-    np.testing.assert_allclose(np.asarray(back["momentums"]),
-                               np.asarray(mem["momentums"]))
-    np.testing.assert_allclose(np.asarray(back["velocities"]),
-                               np.asarray(mem["velocities"]))
+    # per-name contents round-trip; gap slots stay structurally zero
+    for mkey, val in (("momentums", 1.0), ("velocities", 2.0)):
+        named_b = layout.unflatten_named(back[mkey], keep_1d=True)
+        for n in layout.names:
+            np.testing.assert_allclose(np.asarray(named_b[n]), val)
+        b = np.asarray(back[mkey])
+        assert (b[layout.t_data:layout.t_compressed] == 0).all()

@@ -1,0 +1,103 @@
+"""Pallas kernels (dgc_tpu.ops.kernels) must match their jnp reference
+implementations (SURVEY.md §7 item 6 contract; elementwise kernels to one
+ULP — FMA contraction — and integer counts exactly). On CPU the kernels run
+in interpreter mode — same program the TPU compiles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu.ops import kernels
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+@pytest.mark.parametrize("n", [1, 127, 128, 1024, 65536 + 3, 272474])
+def test_fused_compensate_matches_reference(n, nesterov):
+    rng = np.random.RandomState(n)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n), jnp.float32)
+    v = jnp.asarray(rng.randn(n), jnp.float32)
+    om, ov = kernels.fused_compensate(g, m, v, 0.9, nesterov)
+    rm, rv = kernels.fused_compensate_reference(g, m, v, 0.9, nesterov)
+    # FMA contraction in the kernel differs by ~1 ULP of the input
+    # scale; vec+mmt can cancel, so absolute tolerance covers that scale
+    np.testing.assert_allclose(np.asarray(om), np.asarray(rm),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ov), np.asarray(rv),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape", [(1, 64), (3, 128), (5, 1000), (16, 4096)])
+def test_ladder_counts_matches_reference(shape):
+    rng = np.random.RandomState(shape[1])
+    imp = np.abs(rng.randn(*shape)).astype(np.float32)
+    # padding slots, as the engine produces them
+    imp[:, -3:] = -1.0
+    thr = np.abs(rng.randn(shape[0])).astype(np.float32) * 0.5
+    got = kernels.ladder_counts(jnp.asarray(imp), jnp.asarray(thr), 0.8, 11)
+    ref = kernels.ladder_counts_reference(jnp.asarray(imp), jnp.asarray(thr),
+                                          0.8, 11)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_ladder_counts_zero_threshold():
+    """All-zero gradients: thr == 0, every non-padded element passes every
+    level (imp 0 >= 0), padding (-1) never counts."""
+    imp = jnp.concatenate([jnp.zeros((2, 10)), -jnp.ones((2, 2))], axis=1)
+    thr = jnp.zeros((2,))
+    got = np.asarray(kernels.ladder_counts(imp, thr, 0.8, 5))
+    assert (got == 10).all()
+
+
+def test_ladder_adapt_matches_sequential_oracle():
+    """The closed-form ladder pick must equal the reference's sequential
+    adaptation loop (ops.adapt_threshold with resample=True) row by row."""
+    from dgc_tpu.compression.flat import _ladder_adapt
+    from dgc_tpu.ops import sparsify as ops
+
+    rng = np.random.RandomState(7)
+    R, N = 6, 2000
+    imp = np.abs(rng.randn(R, N)).astype(np.float32)
+    num_selects = np.full((R,), 20, np.float32)
+    # thresholds engineered too high so adaptation must lower them by
+    # varying amounts
+    thr0 = np.array([np.sort(imp[r])[-3] for r in range(R)], np.float32)
+    max_iters = 10
+
+    got = np.asarray(_ladder_adapt(
+        jnp.asarray(imp), jnp.asarray(thr0), jnp.asarray(num_selects),
+        jnp.ones((R,), bool), 0.8, max_iters))
+
+    for r in range(R):
+        want = np.asarray(ops.adapt_threshold(
+            jnp.asarray(imp[r]), jnp.asarray(thr0[r]), 20, 0.8, 1.3,
+            max_iters, resample=True))
+        # sequential loop multiplies cumulatively; ladder uses lb**i —
+        # identical picks, float tolerance on the power
+        np.testing.assert_allclose(got[r], want, rtol=1e-5)
+
+
+def test_flat_sparsify_with_adaptation_transmits_enough():
+    """End-to-end through the engine: a distribution that defeats the
+    sampled threshold still transmits >= lower_bound * num_selects after
+    ladder adaptation (the reference's adaptation goal)."""
+    from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer, dgc_sgd
+
+    rng = np.random.RandomState(3)
+    # heavy-tailed: strided samples overestimate the top-k threshold
+    base = np.abs(rng.randn(64, 64)).astype(np.float32)
+    base.reshape(-1)[rng.choice(4096, 40, replace=False)] *= 50.0
+    params = {"w": jnp.asarray(base)}
+    comp = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=0.01)
+    comp.initialize([("w", params["w"])])
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+    layout, engine = dist.make_flat(params)
+    a = comp.attributes["w"]
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:layout.t_data] = base.reshape(-1)
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                         jax.random.PRNGKey(0))
+    valid = np.asarray(idx) < layout.t_data
+    assert valid.sum() >= int(0.8 * a.num_selects) - 1
