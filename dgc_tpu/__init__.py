@@ -21,6 +21,7 @@ from dgc_tpu.compression.memory import Memory, DGCSGDMemory
 from dgc_tpu.compression.base import Compressor, NoneCompressor, FP16Compressor, Compression
 from dgc_tpu.optim.sgd import dgc_sgd, sgd
 from dgc_tpu.optim.distributed import DistributedOptimizer
+from dgc_tpu.optim.adasum import AdasumDistributedOptimizer
 
 __all__ = [
     "DGCCompressor",
@@ -33,4 +34,5 @@ __all__ = [
     "dgc_sgd",
     "sgd",
     "DistributedOptimizer",
+    "AdasumDistributedOptimizer",
 ]

@@ -453,13 +453,31 @@ class FlatDGCEngine:
     # the full exchange                                              #
     # -------------------------------------------------------------- #
 
+    def _dense_combine(self, block: jax.Array, axis_name: str,
+                       world_size: int, op: str) -> jax.Array:
+        """The dense collective: psum-average (hvd.Average), psum (Sum), or
+        pairwise-recursive Adasum (reference allreduce op semantics)."""
+        if op == "adasum":
+            # Adasum's dot/norm accumulations must run in full precision —
+            # an fp16 wire would overflow them to NaN on any real block
+            from dgc_tpu.optim.adasum import adasum_allreduce
+            return adasum_allreduce(block, axis_name, world_size)
+        wire = (block.astype(jnp.float16) if self.c.fp16_values else block)
+        total = jax.lax.psum(wire, axis_name).astype(block.dtype)
+        return total / world_size if op == "average" else total
+
     def exchange(self, flat_grad: jax.Array, mem: Dict, key: jax.Array,
-                 axis_name: str, world_size: int):
+                 axis_name: str, world_size: int, op: str = "average"):
         """compress -> communicate -> decompress over the whole model:
         two ``all_gather`` + one ``psum`` per step, total.
 
+        ``op`` selects the combine semantics: "average" (hvd.Average — the
+        harness default), "sum", or "adasum" (delta-optimizer variant, C5).
+        Compressed payloads divide by world size ONLY for "average"
+        (reference compression.py:192-193).
+
         With no initialized compressed tensors (T == 0, e.g. an uninitialized
-        compressor) every parameter falls through to the dense psum block —
+        compressor) every parameter falls through to the dense block —
         the same graceful degradation as the per-tensor path's
         ``name in attributes`` guard."""
         T, P = self.T, self.layout.total
@@ -474,10 +492,7 @@ class FlatDGCEngine:
         # per-tensor path's non-accumulating correction (dgc.py compress
         # guard `compress_ratio < 1.0 and name in attributes`)
         if T == 0 or self.c.compress_ratio >= 1.0:
-            g_w = (flat_grad.astype(jnp.float16) if self.c.fp16_values
-                   else flat_grad)
-            avg = jax.lax.psum(g_w, axis_name).astype(
-                flat_grad.dtype) / world_size
+            avg = self._dense_combine(flat_grad, axis_name, world_size, op)
             if m is None:
                 return avg, mem
             out, md = self._compensate_dense(mem["momentums"], avg)
@@ -511,13 +526,12 @@ class FlatDGCEngine:
         acc = jnp.zeros((T,), flat_grad.dtype)
         acc = acc.at[g_indices.reshape(-1)].add(
             g_values.reshape(-1).astype(flat_grad.dtype))
-        out_c = acc / world_size          # hvd.Average (compression.py:192-193)
+        # /world_size only under Average (compression.py:192-193)
+        out_c = acc / world_size if op == "average" else acc
 
-        # --- dense fallback block: one psum + average + correction ---
+        # --- dense fallback block: one collective + correction ---
         if P > T:
-            gd_w = gd.astype(jnp.float16) if self.c.fp16_values else gd
-            gd_avg = jax.lax.psum(gd_w, axis_name).astype(
-                flat_grad.dtype) / world_size
+            gd_avg = self._dense_combine(gd, axis_name, world_size, op)
             out_d, md = self._compensate_dense(md, gd_avg)
             out = jnp.concatenate([out_c, out_d])
         else:
@@ -577,10 +591,16 @@ class FlatDenseExchange:
     def init_memory(self) -> Dict:
         return {}
 
-    def exchange(self, flat_grad, mem, key, axis_name, world_size):
+    def exchange(self, flat_grad, mem, key, axis_name, world_size,
+                 op: str = "average"):
+        if op == "adasum":
+            # full precision: fp16 dot/norm accumulations would overflow
+            from dgc_tpu.optim.adasum import adasum_allreduce
+            return adasum_allreduce(flat_grad, axis_name, world_size), mem
         wire = self.c._wire(flat_grad)
-        total = jax.lax.psum(wire, axis_name)
-        out = (self.c._unwire(total, flat_grad.dtype) / world_size).astype(
+        total = self.c._unwire(jax.lax.psum(wire, axis_name),
+                               flat_grad.dtype)
+        out = (total / world_size if op == "average" else total).astype(
             flat_grad.dtype)
         return out, mem
 

@@ -1,0 +1,3 @@
+from dgc_tpu.interop.torch_bridge import TorchDGCBridge
+
+__all__ = ["TorchDGCBridge"]

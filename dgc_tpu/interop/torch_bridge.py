@@ -1,0 +1,171 @@
+"""DLPack bridge: route PyTorch gradients through the JAX/TPU compressor.
+
+BASELINE.json's north star includes a compatibility path where "train.py
+keeps its PyTorch model/data path but routes gradients through the JAX
+compressor via DLPack when --device tpu is set" — this module is that shim.
+A torch training loop keeps its model, autograd, and data pipeline; after
+``loss.backward()`` it hands the named gradients to :class:`TorchDGCBridge`,
+which moves them zero-copy (DLPack) into the flat engine, runs the full
+momentum-corrected sparsify + exchange + decompress on the JAX device mesh,
+and returns exchanged torch gradients to drop into ``p.grad`` before
+``optimizer.step()`` — the same position the reference's hooked
+``synchronize()`` writes decompressed grads (dgc/horovod/optimizer.py:
+141-157).
+
+Zero-copy holds CPU<->CPU; on TPU the transfer is a host->device copy (there
+is no shared memory), which is still the reference's own data path (its GPU
+grads go through Horovod's CPU/MPI staging for large payloads).
+"""
+
+from typing import Dict, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TorchDGCBridge"]
+
+
+class TorchDGCBridge:
+    """Wraps a (DistributedOptimizer, params-template) pair for torch
+    callers.
+
+    Usage::
+
+        bridge = TorchDGCBridge(dist_opt, named_shapes)   # once
+        new_grads = bridge.exchange({name: p.grad for ...})  # per step
+        for name, p in model.named_parameters():
+            p.grad.copy_(new_grads[name])
+
+    The bridge owns the DGC memory state (momentum correction / error
+    feedback) across steps, like the reference's ``DGCSGDMemory`` object.
+    """
+
+    def __init__(self, dist_opt, named_shapes: Dict[str, Tuple[int, ...]],
+                 mesh=None, seed: int = 0):
+        import torch  # local import: torch is optional for the core package
+
+        self._torch = torch
+        self.dist = dist_opt
+        template = {name: jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
+                    for name, shape in named_shapes.items()}
+        zeros = {name: jnp.zeros(s.shape, s.dtype)
+                 for name, s in template.items()}
+        self.layout, self.engine = dist_opt.make_flat(zeros)
+        self.mem = self.engine.init_memory()
+        self.mesh = mesh
+        self._key = jax.random.PRNGKey(seed)
+        self._step = 0
+
+        axis = dist_opt.axis_name
+        world = dist_opt.world_size
+        if self.mesh is None:
+            from dgc_tpu.parallel import make_mesh
+            self.mesh = make_mesh(world)
+        assert self.mesh.devices.size == world, (
+            f"mesh has {self.mesh.devices.size} devices, world_size="
+            f"{world}; with world_size > 1 pass per-worker gradients with "
+            f"a leading [world] axis")
+        self.world = world
+
+        def _exchange(flat_w, mem_w, key):
+            # flat_w: [W, P] per-worker gradients sharded on the data axis;
+            # mem_w: per-worker memory [W, ...]. Replicating one gradient to
+            # W workers would make the exchange a no-op at W-times the cost,
+            # so distinct per-worker inputs are the only multi-worker form.
+            from jax.sharding import PartitionSpec as P
+
+            def worker(fg, m, k):
+                fg = fg[0]
+                m = jax.tree.map(lambda x: x[0], m)
+                k = jax.random.fold_in(k, jax.lax.axis_index(axis))
+                out, m = self.engine.exchange(fg, m, k, axis, world)
+                return out, jax.tree.map(lambda x: x[None], m)
+
+            return jax.shard_map(
+                worker, mesh=self.mesh,
+                in_specs=(P(axis), P(axis), P()),
+                out_specs=(P(), P(axis)),
+                check_vma=False)(flat_w, mem_w, key)
+
+        self._exchange = jax.jit(_exchange)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self._data_sharding = NamedSharding(self.mesh, P(axis))
+        self._repl_sharding = NamedSharding(self.mesh, P())
+        self.mem = jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(x[None], (world,) + x.shape),
+                self._data_sharding),
+            self.mem)
+
+    def _to_jax(self, t):
+        """torch tensor -> jax array (DLPack when possible)."""
+        try:
+            return jnp.from_dlpack(t.detach().contiguous())
+        except Exception:
+            return jnp.asarray(t.detach().cpu().numpy())
+
+    def _to_torch(self, a):
+        try:
+            return self._torch.from_dlpack(a)
+        except Exception:
+            return self._torch.from_numpy(np.asarray(a))
+
+    def exchange(self, named_grads: Dict) -> Dict:
+        """Run compress -> exchange -> decompress on the device mesh.
+
+        ``named_grads`` values are torch tensors of the declared shapes
+        (world_size == 1) or with a leading ``[world]`` axis of per-worker
+        gradients. Returns {name: torch tensor} of exchanged gradients
+        (without the world axis — the result is identical on every worker).
+        """
+        from dgc_tpu.utils.pytree import named_unflatten
+        W = self.world
+
+        def grab(n, w):
+            if n not in named_grads:
+                return jnp.zeros(self.layout.shapes[n], jnp.float32)
+            g = self._to_jax(named_grads[n]).astype(jnp.float32)
+            if W > 1:
+                g = g.reshape((W,) + self.layout.shapes[n])[w]
+            return g.reshape(self.layout.shapes[n])
+
+        flat_w = jnp.stack([
+            self.layout.flatten(named_unflatten(
+                {n: grab(n, w) for n in self.layout._tree_order},
+                self.layout.treedef))
+            for w in range(W)])
+        flat_w = jax.device_put(flat_w, self._data_sharding)
+        key = jax.device_put(jax.random.fold_in(self._key, self._step),
+                             self._repl_sharding)
+        self._step += 1
+        out, self.mem = self._exchange(flat_w, self.mem, key)
+        named_out = self.layout.unflatten_named(out)
+        # DLPack hand-off (zero-copy CPU<->CPU); numpy fallback inside
+        return {n: self._to_torch(named_out[n]) for n in named_grads}
+
+    # checkpoint protocol (reference memory.py:79-88); per-worker buffers
+    # keep their leading [world] axis, matching the reference's per-rank
+    # checkpoint files (train.py:60-68)
+    def state_dict(self):
+        if not self.mem:
+            return None
+        lay = self.layout
+        return {k: {n: np.asarray(
+            buf[:, lay.offsets[n]:lay.offsets[n] + lay.sizes[n]])
+            for n in lay.names} for k, buf in self.mem.items()}
+
+    def load_state_dict(self, saved):
+        if not self.mem or saved is None:
+            return
+        lay = self.layout
+        new = {}
+        for k, buf in self.mem.items():
+            host = np.asarray(buf)
+            for n in lay.names:
+                if n in saved[k]:
+                    piece = np.asarray(saved[k][n]).reshape(self.world, -1)
+                    host[:, lay.offsets[n]:lay.offsets[n]
+                         + lay.sizes[n]] = piece
+            new[k] = jnp.asarray(host)
+        self.mem = new

@@ -1,4 +1,6 @@
 from dgc_tpu.optim.sgd import SGDState, dgc_sgd, sgd
 from dgc_tpu.optim.distributed import DistributedOptimizer
+from dgc_tpu.optim.adasum import AdasumDistributedOptimizer, adasum_allreduce
 
-__all__ = ["SGDState", "dgc_sgd", "sgd", "DistributedOptimizer"]
+__all__ = ["SGDState", "dgc_sgd", "sgd", "DistributedOptimizer",
+           "AdasumDistributedOptimizer", "adasum_allreduce"]

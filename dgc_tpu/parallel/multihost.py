@@ -1,0 +1,99 @@
+"""Multi-host initialization — the launcher-side counterpart of the
+reference's ``hvd.init()`` over OpenMPI (/root/reference/train.py:412,
+README.md:89-104).
+
+On TPU pods there is no mpirun: every host runs the SAME program,
+``jax.distributed.initialize()`` wires the hosts together over DCN (reading
+the TPU metadata or the coordinator address from the environment), and
+``jax.devices()`` then spans the whole pod. The data mesh covers all chips;
+collectives ride ICI within a host/slice and DCN across — exactly where the
+reference's "intra-machine dense, inter-machine sparse" simulation
+(README.md:133-134) becomes a real two-tier fabric.
+
+Launchers in ``script/`` show the three standard entries: single host,
+``gcloud ... tpu-vm ssh --worker=all`` pods, and Slurm
+(``sample_slurm.sh`` parity).
+"""
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["initialize_multihost", "is_coordinator", "local_batch_slice"]
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Call ``jax.distributed.initialize`` when running multi-host.
+
+    With no arguments, TPU pod environments are auto-detected (the TPU
+    metadata service supplies coordinator/worker ids). For CPU/GPU clusters
+    (e.g. under Slurm) pass the coordinator explicitly or export
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+    — the same triple the launcher scripts derive from Slurm variables
+    (reference sample_slurm.sh:36-52 builds the equivalent -H list).
+
+    Returns True when distributed init ran, False for single-process runs.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    # Slurm: per-task variables are only visible inside the srun task, so
+    # read them here rather than exporting from the sbatch batch step
+    # (where SLURM_PROCID is always 0)
+    if num_processes is None and "SLURM_NTASKS" in os.environ:
+        num_processes = int(os.environ["SLURM_NTASKS"])
+    if process_id is None and "SLURM_PROCID" in os.environ:
+        process_id = int(os.environ["SLURM_PROCID"])
+
+    # TPU_WORKER_HOSTNAMES lists every host of a pod slice; a single entry
+    # (no comma) is a one-host environment — nothing to wire up
+    pod_hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multi = (coordinator_address is not None
+             or "," in pod_hosts
+             or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    if not multi:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return True
+
+
+def is_coordinator() -> bool:
+    """Rank-0 check (the reference's ``hvd.rank() == 0`` gating for logging
+    and checkpoint bookkeeping, train.py:406-408)."""
+    return jax.process_index() == 0
+
+
+def local_batch_slice(global_batch: int):
+    """The slice of a [global_batch, ...] host array this process should
+    feed. Data loading is per-host: each process materializes only its
+    shard (the DistributedSampler role, reference train.py:99-100)."""
+    per = global_batch // jax.process_count()
+    start = jax.process_index() * per
+    return slice(start, start + per)
+
+
+def host_local_to_global(arr, mesh, axis: str = "data"):
+    """Host batch array -> global ``jax.Array`` sharded on the data axis.
+
+    Single process: a sharded device_put. Multi-process: a jit over a
+    pod-spanning mesh cannot take process-local arrays — each host keeps
+    only its :func:`local_batch_slice` and the global array is assembled
+    with ``jax.make_array_from_process_local_data`` (the input-pipeline
+    contract of multi-host JAX; this is the harness's replacement for the
+    reference's DistributedSampler, train.py:99-100)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P(axis))
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    local = arr[local_batch_slice(arr.shape[0])]
+    return jax.make_array_from_process_local_data(sharding, local,
+                                                  arr.shape)

@@ -1,0 +1,84 @@
+"""Tracing / profiling helpers (SURVEY.md §5 "Tracing / profiling").
+
+The reference ships no profiler; its only performance artifact is the
+wall-clock speedup figure (README.md:24-25). The TPU build does better:
+
+* :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable device trace (XPlane) for any code region; the
+  harness exposes it as ``--profile`` (traces land under
+  ``<save_path>/profile``).
+* :func:`step_timer` — wall-clock step statistics with device sync, used by
+  ``bench.py``.
+* :func:`exchange_report` — the north-star observable: gradient-exchange
+  cost of a (dist_opt, engine) pair measured by differencing full steps
+  against a no-exchange variant on the same inputs.
+"""
+
+import contextlib
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["trace", "step_timer", "annotate", "exchange_report"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str, enabled: bool = True):
+    """Device-level profiler trace (view in TensorBoard / Perfetto)."""
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-region inside an active trace (shows as a track event)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_timer(step_fn: Callable, *args, warmup: int = 3, iters: int = 20,
+               sync: Callable = None) -> Dict[str, float]:
+    """median/p10/p90 wall-clock ms of ``step_fn(*args)``; ``sync`` extracts
+    a value to block on (defaults to the whole output)."""
+    out = None
+    for _ in range(warmup):
+        out = step_fn(*args)
+    jax.block_until_ready(sync(out) if sync else out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = step_fn(*args)
+        jax.block_until_ready(sync(out) if sync else out)
+        times.append((time.perf_counter() - t0) * 1000)
+    t = np.asarray(times)
+    return {"median_ms": float(np.median(t)),
+            "p10_ms": float(np.percentile(t, 10)),
+            "p90_ms": float(np.percentile(t, 90))}
+
+
+def exchange_report(dgc_ms: float, dense_ms: float, payload_elems: int,
+                    num_params: int, workers: int,
+                    fabric_gbps: float) -> Dict[str, float]:
+    """Grad-exchange accounting used by bench.py: measured on-device
+    overhead plus a stated wire model (ring allreduce vs sparse allgather,
+    f32 values + int32 indices)."""
+    dense_wire_ms = (2 * 4 * num_params * (workers - 1) / workers) / (
+        fabric_gbps * 1e9) * 1e3
+    dgc_wire_ms = ((workers - 1) * payload_elems * 8) / (
+        fabric_gbps * 1e9) * 1e3
+    overhead = max(dgc_ms - dense_ms, 0.0)
+    return {
+        "dense_exchange_ms": dense_wire_ms,
+        "dgc_exchange_ms": overhead + dgc_wire_ms,
+        "dgc_wire_ms": dgc_wire_ms,
+        "dgc_compute_overhead_ms": overhead,
+        "speedup": dense_wire_ms / (overhead + dgc_wire_ms),
+        "wire_reduction": (2 * 4 * num_params * (workers - 1) / workers) /
+                          max((workers - 1) * payload_elems * 8, 1),
+    }

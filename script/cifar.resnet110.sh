@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# CIFAR-10 / ResNet-110 with DGC (reference script/cifar.resnet110.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python train.py \
+  --configs configs/cifar/resnet110.py configs/dgc/wm5.py \
+  "$@"

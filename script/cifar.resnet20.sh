@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# CIFAR-10 / ResNet-20 with DGC at 0.1% (reference script/cifar.resnet20.sh).
+# One process drives every local TPU chip as a data-parallel mesh — there is
+# no mpirun/horovodrun tier (reference README.md:89-104); XLA collectives
+# over ICI replace Horovod/OpenMPI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python train.py \
+  --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+  "$@"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# ImageNet / ResNet-50 with DGC at 0.1% (reference script/imagenet.resnet50.sh,
+# wm0 = no warm-up epochs as in the reference's command line).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python train.py \
+  --configs configs/imagenet/resnet50.py configs/dgc/wm0.py \
+  "$@"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# ImageNet / VGG-16-BN with DGC (reference script/imagenet.vgg16.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python train.py \
+  --configs configs/imagenet/vgg16_bn.py configs/dgc/wm0.py \
+  "$@"

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+#SBATCH --job-name=dgc-tpu
+#SBATCH --nodes=4
+#SBATCH --ntasks-per-node=1
+#SBATCH --requeue
+# Slurm launcher (reference sample_slurm.sh parity). Where the reference
+# built an mpirun -H host:slots list from SLURM_JOB_NODELIST
+# (sample_slurm.sh:36-52), JAX needs only the coordinator address — one task
+# per host, every task runs the same train.py; the per-task rank/count come
+# from SLURM_PROCID/SLURM_NTASKS, which initialize_multihost() reads INSIDE
+# each srun task (they are not meaningful in this batch step). --requeue
+# plus the per-epoch checkpoints (train.py resume path) gives the same
+# requeue-resume story.
+set -euo pipefail
+
+export JAX_COORDINATOR_ADDRESS="$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1):8476"
+
+srun python train.py \
+  --configs configs/imagenet/resnet50.py configs/dgc/wm0.py \
+  "$@"

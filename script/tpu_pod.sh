@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher — the reference's horovodrun/mpirun tier
+# (README.md:89-104) replaced by "same program on every host":
+# jax.distributed.initialize() (dgc_tpu/parallel/multihost.py) wires hosts
+# over DCN and the data mesh spans the pod.
+#
+# Usage:
+#   TPU_NAME=my-pod ZONE=us-central2-b ./script/tpu_pod.sh \
+#       configs/imagenet/resnet50.py configs/dgc/wm0.py [overrides...]
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the TPU pod name}"
+: "${ZONE:?set ZONE to the TPU zone}"
+REPO_DIR=${REPO_DIR:-$(basename "$(cd "$(dirname "$0")/.." && pwd)")}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $REPO_DIR && python train.py --configs $*"

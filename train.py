@@ -60,6 +60,9 @@ def main():
                         help="force an N-fake-device CPU mesh (testing)")
     parser.add_argument("--evaluate", action="store_true")
     parser.add_argument("--suffix", default="")
+    parser.add_argument("--profile", action="store_true",
+                        help="write a device trace of the first training "
+                             "steps to <save_path>/profile")
     args, opts = parser.parse_known_args()
 
     if args.cpu_mesh or args.devices == "cpu":
@@ -71,6 +74,14 @@ def main():
     import jax
     if args.cpu_mesh or args.devices == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    # multi-host wiring (TPU pods / Slurm; no-op single host) must precede
+    # ANY backend use — even a jax.process_index() in a log line initializes
+    # the local backend and breaks jax.distributed.initialize
+    if not (args.cpu_mesh or args.devices == "cpu"):
+        from dgc_tpu.parallel.multihost import initialize_multihost
+        _multihost = initialize_multihost()
+    else:
+        _multihost = False
     import jax.numpy as jnp
 
     from dgc_tpu.compression.flat import ParamLayout
@@ -97,8 +108,13 @@ def main():
     Config.update_from_modules(*args.configs)
     Config.update_from_arguments(*opts)
 
+    if _multihost:
+        printr(f"[multihost] {jax.process_count()} processes, "
+               f"{len(jax.devices())} devices")
+
     seed = configs.get("seed", 0) or 0
     np.random.seed(seed)
+    from dgc_tpu.parallel.multihost import host_local_to_global
 
     configs.train.num_batches_per_step = configs.train.get(
         "num_batches_per_step", 1)
@@ -208,7 +224,8 @@ def main():
                                  shuffle=False):
             images, labels = ds.get_batch(idx)
             counts = eval_fn(state.params, state.batch_stats,
-                             jnp.asarray(images), jnp.asarray(labels))
+                             host_local_to_global(images, mesh),
+                             host_local_to_global(labels, mesh))
             n = int(counts["count"])
             for meter in meters.values():
                 meter.update_counts(int(counts[f"top{meter.k}"]), n)
@@ -250,20 +267,36 @@ def main():
         seen = 0
         metrics = None
         base_key = jax.random.PRNGKey(seed)
-        for bidx, idx in enumerate(epoch_batches(
-                len(ds), global_batch, epoch=epoch, seed=seed,
-                drop_last=nbps > 1)):
-            images, labels = ds.get_batch(idx)
-            state, metrics = step_fn(state, jnp.asarray(images),
-                                     jnp.asarray(labels),
-                                     jax.random.fold_in(
-                                         base_key, epoch * 100003 + bidx))
-            seen += 1
-            num_inputs += global_batch
-            logged = bidx % 50 == 0
-            if logged:
-                writer.add_scalar("loss/train", float(metrics["loss"]),
-                                  num_inputs)
+        # --profile traces the first 8 steps of the first trained epoch and
+        # then keeps training normally (the trace stops, the epoch doesn't)
+        profile_left = 8 if (args.profile and epoch == last_epoch + 1) else 0
+        if profile_left:
+            jax.profiler.start_trace(
+                os.path.join(configs.train.save_path, "profile"))
+        try:
+            for bidx, idx in enumerate(epoch_batches(
+                    len(ds), global_batch, epoch=epoch, seed=seed,
+                    drop_last=nbps > 1)):
+                images, labels = ds.get_batch(idx)
+                state, metrics = step_fn(state,
+                                         host_local_to_global(images, mesh),
+                                         host_local_to_global(labels, mesh),
+                                         jax.random.fold_in(
+                                             base_key, epoch * 100003 + bidx))
+                if profile_left:
+                    profile_left -= 1
+                    if profile_left == 0:
+                        jax.block_until_ready(metrics["loss"])
+                        jax.profiler.stop_trace()
+                seen += 1
+                num_inputs += global_batch
+                logged = bidx % 50 == 0
+                if logged:
+                    writer.add_scalar("loss/train", float(metrics["loss"]),
+                                      num_inputs)
+        finally:
+            if profile_left:         # epoch shorter than the trace window
+                jax.profiler.stop_trace()
         dt = time.time() - t0
         if metrics is None:
             printr("[warn] epoch produced no batches "
