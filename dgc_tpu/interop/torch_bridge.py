@@ -122,19 +122,17 @@ class TorchDGCBridge:
         from dgc_tpu.utils.pytree import named_unflatten
         W = self.world
 
-        def grab(n, w):
+        # convert each tensor ONCE to [W, shape], then one vmapped flatten
+        def grab(n):
             if n not in named_grads:
-                return jnp.zeros(self.layout.shapes[n], jnp.float32)
+                return jnp.zeros((W,) + self.layout.shapes[n], jnp.float32)
             g = self._to_jax(named_grads[n]).astype(jnp.float32)
-            if W > 1:
-                g = g.reshape((W,) + self.layout.shapes[n])[w]
-            return g.reshape(self.layout.shapes[n])
+            return g.reshape((W,) + self.layout.shapes[n])
 
-        flat_w = jnp.stack([
-            self.layout.flatten(named_unflatten(
-                {n: grab(n, w) for n in self.layout._tree_order},
-                self.layout.treedef))
-            for w in range(W)])
+        tree_w = named_unflatten({n: grab(n)
+                                  for n in self.layout._tree_order},
+                                 self.layout.treedef)
+        flat_w = jax.vmap(self.layout.flatten)(tree_w)
         flat_w = jax.device_put(flat_w, self._data_sharding)
         key = jax.device_put(jax.random.fold_in(self._key, self._step),
                              self._repl_sharding)
@@ -146,26 +144,26 @@ class TorchDGCBridge:
 
     # checkpoint protocol (reference memory.py:79-88); per-worker buffers
     # keep their leading [world] axis, matching the reference's per-rank
-    # checkpoint files (train.py:60-68)
+    # checkpoint files (train.py:60-68). Delegates to the engine's
+    # per-name slice/merge helpers — one worker row at a time.
     def state_dict(self):
         if not self.mem:
             return None
-        lay = self.layout
-        return {k: {n: np.asarray(
-            buf[:, lay.offsets[n]:lay.offsets[n] + lay.sizes[n]])
-            for n in lay.names} for k, buf in self.mem.items()}
+        rows = [self.engine.memory_state_dict(
+            {k: v[w] for k, v in self.mem.items()})
+            for w in range(self.world)]
+        return {k: {n: np.stack([np.asarray(r[k][n]) for r in rows])
+                    for n in rows[0][k]} for k in rows[0]}
 
     def load_state_dict(self, saved):
         if not self.mem or saved is None:
             return
-        lay = self.layout
-        new = {}
-        for k, buf in self.mem.items():
-            host = np.asarray(buf)
-            for n in lay.names:
-                if n in saved[k]:
-                    piece = np.asarray(saved[k][n]).reshape(self.world, -1)
-                    host[:, lay.offsets[n]:lay.offsets[n]
-                         + lay.sizes[n]] = piece
-            new[k] = jnp.asarray(host)
-        self.mem = new
+        merged = []
+        for w in range(self.world):
+            saved_w = {k: {n: np.asarray(v)[w] for n, v in d.items()}
+                       for k, d in saved.items()}
+            merged.append(self.engine.load_memory_state_dict(
+                {k: v[w] for k, v in self.mem.items()}, saved_w))
+        self.mem = {k: jax.device_put(
+            jnp.stack([m[k] for m in merged]), self._data_sharding)
+            for k in merged[0]}

@@ -85,7 +85,13 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
     """Delta-optimizer composition: local base-optimizer step, compressed
     Adasum exchange of the delta. Flat-engine path only (the per-tensor
     oracle path exchanges gradients, not deltas — use the default
-    ``DistributedOptimizer`` there, as the reference harness does)."""
+    ``DistributedOptimizer`` there, as the reference harness does).
+
+    The base optimizer steps on LOCAL gradients (reference
+    optimizer.py:267-275), so its state is per-worker — the train step
+    stores it with a leading [world] axis like the DGC memory."""
+
+    per_worker_opt_state = True
 
     def update(self, grads, opt_state, params, mem_state, key=None):
         raise NotImplementedError(

@@ -44,6 +44,11 @@ class DistributedOptimizer:
       fuse_payloads: concatenate sparse payloads into one exchange.
     """
 
+    #: True when the wrapped optimizer steps on LOCAL (pre-exchange)
+    #: gradients and its state is therefore per-worker (Adasum scheme) —
+    #: the train step then stores it with a leading [world] axis
+    per_worker_opt_state = False
+
     def __init__(self, optimizer: optax.GradientTransformation,
                  compressor: Compressor, axis_name: str = "data",
                  world_size: int = 1, fuse_payloads: bool = True):

@@ -40,21 +40,28 @@ def with_leading_axis(tree: Any, world_size: int) -> Any:
         if hasattr(x, "shape") else x, tree)
 
 
-def state_specs(state: TrainState, axis: str = "data") -> TrainState:
-    """PartitionSpec pytree for shard_map in/out_specs."""
+def state_specs(state: TrainState, axis: str = "data",
+                per_worker_opt: bool = False) -> TrainState:
+    """PartitionSpec pytree for shard_map in/out_specs.
+
+    ``per_worker_opt``: the Adasum delta-optimizer scheme steps the base
+    optimizer on LOCAL gradients, so its state is genuinely per-worker
+    (leading [world] axis, like the memory) — declaring it replicated would
+    silently keep only shard 0 on any host materialization."""
     return TrainState(
         step=P(),
         params=jax.tree.map(lambda _: P(), state.params),
-        opt_state=jax.tree.map(lambda _: P(), state.opt_state),
+        opt_state=jax.tree.map(lambda _: P(axis) if per_worker_opt else P(),
+                               state.opt_state),
         memory=jax.tree.map(lambda _: P(axis), state.memory),
         batch_stats=jax.tree.map(lambda _: P(axis), state.batch_stats),
     )
 
 
-def shard_state(state: TrainState, mesh: Mesh,
-                axis: str = "data") -> TrainState:
+def shard_state(state: TrainState, mesh: Mesh, axis: str = "data",
+                per_worker_opt: bool = False) -> TrainState:
     """Place state on the mesh with the canonical shardings."""
-    specs = state_specs(state, axis)
+    specs = state_specs(state, axis, per_worker_opt)
     return jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
         state, specs)

@@ -61,10 +61,13 @@ def make_flat_state(variables, dist_opt: DistributedOptimizer,
     per-worker with a leading [world] axis, as in ``dgc_tpu.training.state``)."""
     flat_params = setup.layout.flatten(variables["params"])
     flat_stats = setup.stats_layout.flatten(variables.get("batch_stats", {}))
+    opt_state = dist_opt.init(flat_params)
+    if dist_opt.per_worker_opt_state:
+        opt_state = with_leading_axis(opt_state, world_size)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=flat_params,
-        opt_state=dist_opt.init(flat_params),
+        opt_state=opt_state,
         memory=with_leading_axis(setup.engine.init_memory(), world_size),
         batch_stats=with_leading_axis(flat_stats, world_size))
 
@@ -136,18 +139,20 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         pack_grads = layout.flatten
         pack_stats = stats_layout.flatten
 
-        def do_update(grads, state, memory, key):
+        def do_update(grads, params, opt_state, memory, key):
             upd, opt_state, memory = dist_opt.update_flat(
-                grads, state.opt_state, state.params, memory, key, engine)
-            return state.params + upd, opt_state, memory
+                grads, opt_state, params, memory, key, engine)
+            return params + upd, opt_state, memory
     else:
         unpack_params = unpack_stats = pack_grads = pack_stats = (
             lambda x: x)
 
-        def do_update(grads, state, memory, key):
+        def do_update(grads, params, opt_state, memory, key):
             upd, opt_state, memory = dist_opt.update(
-                grads, state.opt_state, state.params, memory, key)
-            return optax.apply_updates(state.params, upd), opt_state, memory
+                grads, opt_state, params, memory, key)
+            return optax.apply_updates(params, upd), opt_state, memory
+
+    per_worker_opt = dist_opt.per_worker_opt_state
 
     def worker(state: TrainState, images, labels, key):
         params = unpack_params(state.params)
@@ -176,15 +181,18 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                     jnp.zeros((), jnp.int32)),
             (mb_images, mb_labels))
 
-        new_params, opt_state, memory = do_update(grads, state, memory,
-                                                  sparsify_key)
+        opt_state = (_squeeze0(state.opt_state) if per_worker_opt
+                     else state.opt_state)
+        new_params, opt_state, memory = do_update(
+            grads, state.params, opt_state, memory, sparsify_key)
 
         mean_loss = jax.lax.psum(loss, axis) / world
 
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
-            opt_state=opt_state,
+            opt_state=(_expand0(opt_state) if per_worker_opt
+                       else opt_state),
             memory=_expand0(memory),
             batch_stats=_expand0(packed_stats),
         )
@@ -192,7 +200,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step_fn(state, images, labels, key):
-        specs = state_specs(state, axis)
+        specs = state_specs(state, axis, per_worker_opt)
         sharded = jax.shard_map(
             worker, mesh=mesh,
             in_specs=(specs, P(axis), P(axis), P()),

@@ -185,6 +185,72 @@ def test_torch_bridge_roundtrip():
     assert np.isfinite(out2["w"].numpy()).all()
 
 
+def test_adasum_train_step_per_worker_opt_state(mesh8):
+    """Full flat train step with Adasum: the local base-optimizer state is
+    per-worker ([world] leading axis, sharded on the data axis) and
+    genuinely diverges across workers on distinct data — a replicated spec
+    would silently keep only shard 0 on host materialization."""
+    from flax import linen as nn
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    model = M()
+    v = {"params": model.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 8)))["params"],
+         "batch_stats": {}}
+
+    def apply_fn(variables, x, train=True, mutable=None, rngs=None):
+        out = model.apply({"params": variables["params"]}, x, train=train)
+        return (out, {"batch_stats": {}}) if mutable else out
+
+    dist = AdasumDistributedOptimizer(
+        sgd(0.05, momentum=0.9), Compression.none(), world_size=W)
+    assert dist.per_worker_opt_state
+    setup = make_flat_setup(v, dist)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh8,
+                        per_worker_opt=True)
+    assert state.opt_state.momentum_buffer.shape[0] == W
+    step = build_train_step(apply_fn, dist, mesh8, flat=setup)
+
+    rng = np.random.RandomState(8)
+    images = jnp.asarray(rng.randn(W * 4, 8), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 4, W * 4), jnp.int32)
+    for i in range(2):
+        state, m = step(state, images, labels, jax.random.PRNGKey(i))
+    assert np.isfinite(float(m["loss"]))
+    buf = np.asarray(jax.device_get(state.opt_state.momentum_buffer))
+    # distinct per-worker data -> distinct local momentum buffers survive
+    # the round trip to host
+    assert not np.allclose(buf[0], buf[1])
+
+
+def test_torch_bridge_state_dict_roundtrip(mesh8):
+    torch = pytest.importorskip("torch")
+    shapes = {"w": (8, 16)}
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=1.0)
+    comp.initialize([("w", jnp.zeros(shapes["w"]))])
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    from dgc_tpu.interop import TorchDGCBridge
+    bridge = TorchDGCBridge(dist, shapes, mesh=mesh8)
+    bridge.exchange({"w": torch.randn(W, 8, 16)})
+    sd = bridge.state_dict()
+    assert sd["velocities"]["w"].shape[0] == W
+    assert np.abs(sd["velocities"]["w"]).sum() > 0
+
+    bridge2 = TorchDGCBridge(dist, shapes, mesh=mesh8)
+    bridge2.load_state_dict(sd)
+    sd2 = bridge2.state_dict()
+    for k in sd:
+        np.testing.assert_allclose(sd2[k]["w"], sd[k]["w"], rtol=1e-6)
+
+
 def test_multihost_helpers_single_process():
     from dgc_tpu.parallel.multihost import (
         initialize_multihost, is_coordinator, local_batch_slice)

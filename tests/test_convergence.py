@@ -1,0 +1,117 @@
+"""Convergence parity (SURVEY.md §4 "convergence-as-test"): the reference's
+headline claim is that 99.9%-sparse exchange with momentum-corrected error
+feedback matches dense training (README.md:117-128 accuracy tables). On a
+learnable synthetic task over the 8-way mesh:
+
+* DGC at aggressive sparsity must track the dense baseline's loss curve;
+* removing the error-feedback memory at the same sparsity must be WORSE —
+  the memory is what makes sparsity safe (the paper's central mechanism).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from dgc_tpu import (
+    Compression,
+    DGCCompressor,
+    DGCSGDMemory,
+    DistributedOptimizer,
+    Memory,
+    dgc_sgd,
+    sgd,
+)
+from dgc_tpu.training import (
+    build_train_step,
+    make_flat_setup,
+    make_flat_state,
+    shard_state,
+)
+from dgc_tpu.utils.pytree import named_flatten
+
+W = 8
+BS = 8          # per-worker
+CLASSES = 10
+STEPS = 120
+
+
+class TinyCNN(nn.Module):
+    """Small BN-free conv net — fast on the CPU mesh, dim>1 kernels so DGC
+    compresses the bulk of the parameters."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.Conv(16, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = nn.Conv(32, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(CLASSES)(x)
+
+
+@pytest.fixture(scope="module")
+def task():
+    """Learnable task: class prototypes + noise."""
+    rng = np.random.RandomState(0)
+    protos = rng.randn(CLASSES, 16, 16, 3).astype(np.float32)
+    labels = rng.randint(0, CLASSES, W * BS).astype(np.int32)
+    images = (protos[labels]
+              + 0.3 * rng.randn(W * BS, 16, 16, 3)).astype(np.float32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def _train(memory, compress_ratio, task, mesh, dense=False, steps=STEPS):
+    images, labels = task
+    model = TinyCNN()
+    v = {"params": model.init(jax.random.PRNGKey(7),
+                              jnp.zeros((1, 16, 16, 3)))["params"],
+         "batch_stats": {}}
+
+    if dense:
+        dist = DistributedOptimizer(
+            sgd(0.05, momentum=0.9, weight_decay=1e-4), Compression.none(),
+            world_size=W)
+    else:
+        comp = DGCCompressor(compress_ratio, memory=memory)
+        named, _ = named_flatten(v["params"])
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        dist = DistributedOptimizer(
+            dgc_sgd(0.05, momentum=0.9, weight_decay=1e-4), comp,
+            world_size=W)
+
+    def apply_fn(variables, x, train=True, mutable=None, rngs=None):
+        out = model.apply({"params": variables["params"]}, x, train=train)
+        if mutable:
+            return out, {"batch_stats": {}}
+        return out
+
+    setup = make_flat_setup(v, dist)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh)
+    step = build_train_step(apply_fn, dist, mesh, flat=setup)
+    losses = []
+    for i in range(steps):
+        state, m = step(state, images, labels, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_dgc_parity_and_memory_ablation(mesh8, task):
+    dense = _train(None, None, task, mesh8, dense=True)
+    dgc = _train(DGCSGDMemory(momentum=0.9), 0.01, task, mesh8)
+    nomem = _train(Memory(), 0.01, task, mesh8)
+
+    assert all(np.isfinite(dense)) and all(np.isfinite(dgc))
+    # both learn the task
+    assert dense[-1] < 0.35 * dense[0], (dense[0], dense[-1])
+    # parity: DGC's final loss within 1.5x of dense (the reference's
+    # accuracy-parity claim, in loss-curve form)
+    assert dgc[-1] < max(1.5 * dense[-1], 0.35 * dgc[0]), (
+        dense[-1], dgc[-1])
+    # ablation: stripping the error-feedback memory at 1% sparsity must be
+    # clearly worse than DGC with memory — the momentum-corrected local
+    # accumulation is the mechanism (reference memory.py:50-77)
+    assert nomem[-1] > 1.2 * dgc[-1], (nomem[-1], dgc[-1])
