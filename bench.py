@@ -83,7 +83,8 @@ def main():
         """min over repeats of (median over iters): robust to transient
         host/tunnel interference between runs."""
         setup = make_flat_setup(v, dist)
-        state = shard_state(make_flat_state(v, dist, setup, W), mesh)
+        state = shard_state(make_flat_state(v, dist, setup, W), mesh,
+                            dist_opt=dist)
         step = build_train_step(model.apply, dist, mesh, flat=setup)
         best = None
         for _ in range(repeats):

@@ -21,6 +21,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dgc_tpu.data.native import crop_flip_normalize
+
 __all__ = ["ArraySplit", "SyntheticSplit", "CIFAR", "ImageNet", "Synthetic",
            "CIFAR_MEAN", "CIFAR_STD", "IMAGENET_MEAN", "IMAGENET_STD"]
 
@@ -35,17 +37,14 @@ def _normalize(images_u8: np.ndarray, mean: np.ndarray,
     return (images_u8.astype(np.float32) / 255.0 - mean) / std
 
 
-def _random_crop_flip(images_u8: np.ndarray, pad: int,
-                      rng: np.random.RandomState) -> np.ndarray:
-    """Standard CIFAR augmentation: reflect-free zero-pad + random crop +
-    horizontal flip."""
+def _random_crop_flip_reference(images_u8: np.ndarray, ys, xs, flips,
+                                pad: int) -> np.ndarray:
+    """Per-image oracle for the fused kernels in ``dgc_tpu.data.native``
+    (zero-pad + crop at (ys, xs) + horizontal flip) — tests only."""
     n, h, w, c = images_u8.shape
     padded = np.zeros((n, h + 2 * pad, w + 2 * pad, c), images_u8.dtype)
     padded[:, pad:pad + h, pad:pad + w] = images_u8
     out = np.empty_like(images_u8)
-    ys = rng.randint(0, 2 * pad + 1, size=n)
-    xs = rng.randint(0, 2 * pad + 1, size=n)
-    flips = rng.randint(0, 2, size=n).astype(bool)
     for i in range(n):
         img = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
         out[i] = img[:, ::-1] if flips[i] else img
@@ -74,7 +73,13 @@ class ArraySplit:
                   ) -> Tuple[np.ndarray, np.ndarray]:
         imgs = self.images[indices]
         if self.train and self.augment:
-            imgs = _random_crop_flip(imgs, self.pad, self._rng)
+            n = len(imgs)
+            ys = self._rng.randint(0, 2 * self.pad + 1, size=n)
+            xs = self._rng.randint(0, 2 * self.pad + 1, size=n)
+            flips = self._rng.randint(0, 2, size=n).astype(np.uint8)
+            return (crop_flip_normalize(imgs, ys, xs, flips, self.pad,
+                                        self.mean, self.std),
+                    self.labels[indices])
         return _normalize(imgs, self.mean, self.std), self.labels[indices]
 
 

@@ -59,8 +59,15 @@ def state_specs(state: TrainState, axis: str = "data",
 
 
 def shard_state(state: TrainState, mesh: Mesh, axis: str = "data",
-                per_worker_opt: bool = False) -> TrainState:
-    """Place state on the mesh with the canonical shardings."""
+                per_worker_opt: bool = False,
+                dist_opt=None) -> TrainState:
+    """Place state on the mesh with the canonical shardings.
+
+    Pass the ``DistributedOptimizer`` as ``dist_opt`` and the per-worker
+    opt-state flag is derived from it (``per_worker_opt_state``, the Adasum
+    scheme) — callers then cannot go out of sync with the step builder."""
+    if dist_opt is not None:
+        per_worker_opt = getattr(dist_opt, "per_worker_opt_state", False)
     specs = state_specs(state, axis, per_worker_opt)
     return jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),

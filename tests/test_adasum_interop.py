@@ -213,7 +213,7 @@ def test_adasum_train_step_per_worker_opt_state(mesh8):
     assert dist.per_worker_opt_state
     setup = make_flat_setup(v, dist)
     state = shard_state(make_flat_state(v, dist, setup, W), mesh8,
-                        per_worker_opt=True)
+                        dist_opt=dist)
     assert state.opt_state.momentum_buffer.shape[0] == W
     step = build_train_step(apply_fn, dist, mesh8, flat=setup)
 

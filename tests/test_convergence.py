@@ -90,7 +90,8 @@ def _train(memory, compress_ratio, task, mesh, dense=False, steps=STEPS):
         return out
 
     setup = make_flat_setup(v, dist)
-    state = shard_state(make_flat_state(v, dist, setup, W), mesh)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh,
+                        dist_opt=dist)
     step = build_train_step(apply_fn, dist, mesh, flat=setup)
     losses = []
     for i in range(steps):

@@ -280,7 +280,8 @@ def test_flat_train_step_smoke(mesh8):
     dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4),
                                 comp, world_size=W)
     setup = make_flat_setup(v, dist)
-    state = shard_state(make_flat_state(v, dist, setup, W), mesh8)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh8,
+                        dist_opt=dist)
     step = build_train_step(model.apply, dist, mesh8, flat=setup)
 
     rng = np.random.RandomState(5)

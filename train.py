@@ -154,7 +154,7 @@ def main():
     named_params, _ = named_flatten(params)
 
     # LR: scale by nbps * world, warm up over warmup_lr_epochs (train.py:115-118)
-    from dgc_tpu.data import num_steps_per_epoch
+    from dgc_tpu.data import Prefetcher, epoch_batches, num_steps_per_epoch
     steps_per_epoch = num_steps_per_epoch(
         len(dataset["train"]), global_batch, drop_last=nbps > 1)
     configs.train.base_lr = configs.train.optimizer.lr
@@ -196,7 +196,7 @@ def main():
 
     flat_setup = make_flat_setup(variables, dist)
     state = shard_state(make_flat_state(variables, dist, flat_setup, world),
-                        mesh, axis)
+                        mesh, axis, dist_opt=dist)
 
     # resume from checkpoint (reference train.py:152-165)
     ckpt = CheckpointManager(ckpt_dir, keep=3)
@@ -205,7 +205,8 @@ def main():
         ckpt.latest_epoch() is not None or args.evaluate) else None
     if restored is not None:
         host_state, last_epoch, meters = restored
-        state = shard_state(jax.tree.map(jnp.asarray, host_state), mesh, axis)
+        state = shard_state(jax.tree.map(jnp.asarray, host_state), mesh, axis,
+                            dist_opt=dist)
         best_metric = meters.get(configs.train.metric + "_best")
         printr(f"\n[resumed] epoch {last_epoch}, best {best_metric}")
     else:
@@ -219,7 +220,6 @@ def main():
         for k, meter_cfg in configs.train.meters.items():
             meters[k.format(split)] = meter_cfg()
         ds = dataset[split]
-        from dgc_tpu.data import epoch_batches
         for idx in epoch_batches(len(ds), eval_batch, epoch=0,
                                  shuffle=False):
             images, labels = ds.get_batch(idx)
@@ -244,7 +244,6 @@ def main():
     # Training #
     ############
 
-    from dgc_tpu.data import epoch_batches
     step_fn = None
     num_inputs = (last_epoch + 1) * steps_per_epoch * global_batch
     for epoch in range(last_epoch + 1, configs.train.num_epochs):
@@ -274,10 +273,12 @@ def main():
             jax.profiler.start_trace(
                 os.path.join(configs.train.save_path, "profile"))
         try:
-            for bidx, idx in enumerate(epoch_batches(
-                    len(ds), global_batch, epoch=epoch, seed=seed,
-                    drop_last=nbps > 1)):
-                images, labels = ds.get_batch(idx)
+            # background-thread batch prep (DataLoader-worker role):
+            # host assembles batch k+1 while the device runs step k
+            batches = Prefetcher(ds, epoch_batches(
+                len(ds), global_batch, epoch=epoch, seed=seed,
+                drop_last=nbps > 1))
+            for bidx, (images, labels) in enumerate(batches):
                 state, metrics = step_fn(state,
                                          host_local_to_global(images, mesh),
                                          host_local_to_global(labels, mesh),
