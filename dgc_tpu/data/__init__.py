@@ -5,9 +5,9 @@ from dgc_tpu.data.datasets import (
     ArraySplit,
     SyntheticSplit,
 )
-from dgc_tpu.data.native import Prefetcher, native_available
+from dgc_tpu.data.native import Prefetcher, native_available, stage_ahead
 from dgc_tpu.data.sampler import epoch_batches, num_steps_per_epoch
 
 __all__ = ["CIFAR", "ImageNet", "Synthetic", "ArraySplit", "SyntheticSplit",
            "epoch_batches", "num_steps_per_epoch",
-           "Prefetcher", "native_available"]
+           "Prefetcher", "native_available", "stage_ahead"]

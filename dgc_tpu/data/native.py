@@ -27,7 +27,8 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["crop_flip_normalize", "native_available", "Prefetcher"]
+__all__ = ["crop_flip_normalize", "native_available", "Prefetcher",
+           "stage_ahead"]
 
 _C_SOURCE = r"""
 #include <stdint.h>
@@ -81,11 +82,17 @@ def _build() -> Optional[ctypes.CDLL]:
     concurrent builder can never leave a truncated library behind. ANY
     failure degrades to the numpy fallback."""
     import hashlib
+    import stat
     tag = hashlib.sha256(_C_SOURCE.encode()).hexdigest()[:16]
     try:
         cache = os.path.join(tempfile.gettempdir(),
                              f"dgc_tpu_native_{os.getuid()}")
-        os.makedirs(cache, exist_ok=True)
+        os.makedirs(cache, mode=0o700, exist_ok=True)
+        # never load a library from a directory another user could have
+        # pre-planted at this predictable path
+        st = os.stat(cache)
+        if st.st_uid != os.getuid() or (st.st_mode & stat.S_IWOTH):
+            return None
         so_path = os.path.join(cache, f"libdgcdata_{tag}.so")
         if not os.path.exists(so_path):
             c_path = os.path.join(cache, f"dgcdata_{tag}.c")
@@ -161,6 +168,23 @@ def crop_flip_normalize(images_u8: np.ndarray, ys: np.ndarray,
     return out
 
 
+def stage_ahead(iterator, stage, depth: int = 1):
+    """Keep ``depth`` staged items in flight ahead of the consumer.
+
+    ``stage`` is called on each item as soon as it is pulled (e.g. an async
+    ``device_put``); the consumer receives items in order, so while it works
+    on item k the transfers for k+1..k+depth are already issued — host->
+    device copies overlap device compute instead of serializing with it."""
+    from collections import deque
+    pending = deque()
+    for item in iterator:
+        pending.append(stage(item))
+        if len(pending) > depth:
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()
+
+
 class Prefetcher:
     """Background-thread batch preparation (the DataLoader-worker role):
     the host assembles/augments batch k+1..k+depth while the device runs
@@ -169,18 +193,42 @@ class Prefetcher:
     def __init__(self, split, index_iter: Iterator[np.ndarray],
                  depth: int = 2):
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._fill, args=(split, index_iter), daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _fill(self, split, index_iter):
         try:
             for idx in index_iter:
-                self._q.put(("item", split.get_batch(idx)))
+                if self._stop.is_set() or not self._put(
+                        ("item", split.get_batch(idx))):
+                    return
         except BaseException as e:  # surface worker errors to the consumer
-            self._q.put(("error", e))
+            self._put(("error", e))
             return
-        self._q.put(("end", None))
+        self._put(("end", None))
+
+    def close(self):
+        """Release the worker thread and its buffered batches; safe to call
+        any time (consumers abandoning iteration early MUST call this or
+        the bounded queue pins the thread and several batches forever)."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         while True:

@@ -14,7 +14,7 @@ memory, /root/reference/train.py:244-251):
   checkpoints them per rank (train.py:60-68).
 """
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -59,16 +59,21 @@ def state_specs(state: TrainState, axis: str = "data",
 
 
 def shard_state(state: TrainState, mesh: Mesh, axis: str = "data",
-                per_worker_opt: bool = False,
+                per_worker_opt: Optional[bool] = None,
                 dist_opt=None) -> TrainState:
     """Place state on the mesh with the canonical shardings.
 
     Pass the ``DistributedOptimizer`` as ``dist_opt`` and the per-worker
     opt-state flag is derived from it (``per_worker_opt_state``, the Adasum
-    scheme) — callers then cannot go out of sync with the step builder."""
+    scheme) — callers then cannot go out of sync with the step builder.
+    Supplying BOTH is rejected rather than silently resolved."""
     if dist_opt is not None:
+        if per_worker_opt is not None:
+            raise ValueError(
+                "pass either dist_opt (flag derived) or per_worker_opt, "
+                "not both")
         per_worker_opt = getattr(dist_opt, "per_worker_opt_state", False)
-    specs = state_specs(state, axis, per_worker_opt)
+    specs = state_specs(state, axis, bool(per_worker_opt))
     return jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
         state, specs)

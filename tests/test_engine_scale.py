@@ -1,0 +1,78 @@
+"""Engine construction at ImageNet scale: geometry, buckets, payload, and a
+single exchange for ResNet-18/50 and VGG-16-BN shapes (the BASELINE.json
+config rows beyond CIFAR). Host-side-heavy, device ops on the 1-device CPU
+mesh — catches bucket/padding/overflow issues at real parameter counts
+without a TPU pod."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer, dgc_sgd
+from dgc_tpu.parallel import make_mesh
+from dgc_tpu.utils.pytree import named_flatten
+
+
+def _build(model_fn, num_classes=1000, ratio=0.001, image_size=32):
+    model = model_fn(num_classes=num_classes)
+    v = model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, image_size, image_size, 3)), train=True)
+    named, _ = named_flatten(v["params"])
+    comp = DGCCompressor(ratio, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=1)
+    layout, engine = dist.make_flat(v["params"])
+    return comp, dist, layout, engine
+
+
+@pytest.mark.parametrize("name", ["resnet18", "resnet50", "vgg16_bn"])
+def test_engine_builds_at_imagenet_scale(name):
+    from dgc_tpu import models as M
+    # VGG's classifier head needs the real 224 spatial extent
+    comp, dist, layout, engine = _build(
+        getattr(M, name), image_size=224 if name == "vgg16_bn" else 32)
+    # wire volume == reference's sum of per-tensor num_selects
+    assert engine.payload_size == sum(
+        a.num_selects for a in comp.attributes.values())
+    # every compressed tensor is in exactly one bucket row
+    rows = sum(b.rows for b in engine.buckets)
+    assert rows == len(comp.attributes)
+    # bucket padding bounded by the build factor
+    for b in engine.buckets:
+        real = b.numels[:b.rows]
+        assert b.cols < 2 * max(int(real.max()), 128) + 128 * 1024
+    # ~0.1% of params on the wire
+    assert engine.payload_size < 0.002 * layout.num_params
+    assert layout.num_params > 10_000_000  # genuinely ImageNet scale
+
+
+def test_resnet50_exchange_one_step():
+    """One full exchange at 25M params on the 1-device mesh: compiles, runs,
+    produces finite output of the right shape, and the error-feedback
+    invariant holds (untransmitted coordinates accumulate)."""
+    from dgc_tpu.models import resnet50
+    comp, dist, layout, engine = _build(resnet50)
+    mesh = make_mesh(1)
+    g = jnp.asarray(
+        np.random.RandomState(0).randn(layout.total).astype(np.float32))
+    mem = engine.init_memory()
+
+    def worker(fg, m, key):
+        out, m = engine.exchange(fg, m, key, "data", 1)
+        return out, m
+
+    f = jax.jit(jax.shard_map(
+        worker, mesh=mesh, in_specs=(P(), P(), P()), out_specs=(P(), P()),
+        check_vma=False))
+    out, mem = f(g, mem, jax.random.PRNGKey(0))
+    out = np.asarray(out)
+    assert out.shape == (layout.total,)
+    assert np.isfinite(out).all()
+    # at 0.1% ratio the exchanged compressed block is sparse
+    nz = np.count_nonzero(out[:layout.t_data])
+    assert 0 < nz <= 2 * engine.payload_size
+    # residual accumulated for untransmitted coords
+    assert np.abs(np.asarray(mem["velocities"])[:layout.t_data]).sum() > 0

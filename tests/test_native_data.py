@@ -94,3 +94,31 @@ def test_prefetcher_order_and_errors():
     pf = native.Prefetcher(Split(), iter(np.arange(5)))
     with pytest.raises(RuntimeError, match="boom"):
         list(pf)
+
+
+def test_prefetcher_close_releases_worker():
+    """Abandoning iteration early + close(): the fill thread must exit even
+    though the bounded queue is full."""
+    class Split:
+        def get_batch(self, idx):
+            return np.zeros((2,)), np.zeros((2,))
+
+    pf = native.Prefetcher(Split(), iter(np.arange(100)), depth=2)
+    it = iter(pf)
+    next(it)          # consume one, abandon the rest
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_shard_state_rejects_conflicting_flags():
+    import jax.numpy as jnp
+    import pytest as _pytest
+    from dgc_tpu import Compression, DistributedOptimizer, sgd
+    from dgc_tpu.parallel import make_mesh
+    from dgc_tpu.training import TrainState, shard_state
+
+    state = TrainState(step=jnp.zeros((), np.int32), params=jnp.zeros((4,)),
+                       opt_state=None, memory={}, batch_stats={})
+    dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=1)
+    with _pytest.raises(ValueError, match="not both"):
+        shard_state(state, make_mesh(1), per_worker_opt=True, dist_opt=dist)

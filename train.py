@@ -154,7 +154,8 @@ def main():
     named_params, _ = named_flatten(params)
 
     # LR: scale by nbps * world, warm up over warmup_lr_epochs (train.py:115-118)
-    from dgc_tpu.data import Prefetcher, epoch_batches, num_steps_per_epoch
+    from dgc_tpu.data import (Prefetcher, epoch_batches, num_steps_per_epoch,
+                              stage_ahead)
     steps_per_epoch = num_steps_per_epoch(
         len(dataset["train"]), global_batch, drop_last=nbps > 1)
     configs.train.base_lr = configs.train.optimizer.lr
@@ -272,16 +273,21 @@ def main():
         if profile_left:
             jax.profiler.start_trace(
                 os.path.join(configs.train.save_path, "profile"))
+        batches = None
         try:
-            # background-thread batch prep (DataLoader-worker role):
-            # host assembles batch k+1 while the device runs step k
+            # background-thread batch prep (DataLoader-worker role) plus
+            # one-ahead async device transfer: the host assembles batch
+            # k+1 and its host->device copy is in flight while the device
+            # runs step k
             batches = Prefetcher(ds, epoch_batches(
                 len(ds), global_batch, epoch=epoch, seed=seed,
                 drop_last=nbps > 1))
-            for bidx, (images, labels) in enumerate(batches):
-                state, metrics = step_fn(state,
-                                         host_local_to_global(images, mesh),
-                                         host_local_to_global(labels, mesh),
+            staged = stage_ahead(
+                batches,
+                lambda b: (host_local_to_global(b[0], mesh),
+                           host_local_to_global(b[1], mesh)))
+            for bidx, (images, labels) in enumerate(staged):
+                state, metrics = step_fn(state, images, labels,
                                          jax.random.fold_in(
                                              base_key, epoch * 100003 + bidx))
                 if profile_left:
@@ -296,6 +302,8 @@ def main():
                     writer.add_scalar("loss/train", float(metrics["loss"]),
                                       num_inputs)
         finally:
+            if batches is not None:  # release the prefetch thread on error
+                batches.close()
             if profile_left:         # epoch shorter than the trace window
                 jax.profiler.stop_trace()
         dt = time.time() - t0
