@@ -88,10 +88,11 @@ def _build() -> Optional[ctypes.CDLL]:
         cache = os.path.join(tempfile.gettempdir(),
                              f"dgc_tpu_native_{os.getuid()}")
         os.makedirs(cache, mode=0o700, exist_ok=True)
-        # never load a library from a directory another user could have
-        # pre-planted at this predictable path
+        # never load a library from a directory anyone else could have
+        # pre-planted or can write to at this predictable path
         st = os.stat(cache)
-        if st.st_uid != os.getuid() or (st.st_mode & stat.S_IWOTH):
+        if st.st_uid != os.getuid() or (
+                st.st_mode & (stat.S_IWOTH | stat.S_IWGRP)):
             return None
         so_path = os.path.join(cache, f"libdgcdata_{tag}.so")
         if not os.path.exists(so_path):

@@ -387,3 +387,14 @@ def test_flat_memory_state_dict_roundtrip():
             np.testing.assert_allclose(np.asarray(named_b[n]), val)
         b = np.asarray(back[mkey])
         assert (b[layout.t_data:layout.t_compressed] == 0).all()
+
+
+def test_shard_state_rejects_conflicting_flags():
+    from dgc_tpu.parallel import make_mesh
+    from dgc_tpu.training import TrainState, shard_state
+
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=jnp.zeros((4,)),
+                       opt_state=None, memory={}, batch_stats={})
+    dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=1)
+    with pytest.raises(ValueError, match="not both"):
+        shard_state(state, make_mesh(1), per_worker_opt=True, dist_opt=dist)

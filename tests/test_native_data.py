@@ -108,17 +108,3 @@ def test_prefetcher_close_releases_worker():
     next(it)          # consume one, abandon the rest
     pf.close()
     assert not pf._thread.is_alive()
-
-
-def test_shard_state_rejects_conflicting_flags():
-    import jax.numpy as jnp
-    import pytest as _pytest
-    from dgc_tpu import Compression, DistributedOptimizer, sgd
-    from dgc_tpu.parallel import make_mesh
-    from dgc_tpu.training import TrainState, shard_state
-
-    state = TrainState(step=jnp.zeros((), np.int32), params=jnp.zeros((4,)),
-                       opt_state=None, memory={}, batch_stats={})
-    dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=1)
-    with _pytest.raises(ValueError, match="not both"):
-        shard_state(state, make_mesh(1), per_worker_opt=True, dist_opt=dist)
