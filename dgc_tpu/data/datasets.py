@@ -103,8 +103,13 @@ class SyntheticSplit:
         self.labels = rng.randint(0, num_classes, n).astype(np.int32)
         raw = protos[self.labels] + 1.5 * rng.randn(
             n, image_size, image_size, 3).astype(np.float32)
-        lo, hi = raw.min(), raw.max()
-        self.images = ((raw - lo) / (hi - lo) * 255).astype(np.uint8)
+        # FIXED quantization window (+-4 sigma of proto+noise, std
+        # sqrt(1+1.5^2)): per-split min/max would normalize train and test
+        # on slightly different scales, a covariate shift masquerading as
+        # a generalization gap
+        k = 4.0 * float(np.sqrt(1.0 + 1.5 ** 2))
+        self.images = (np.clip((raw + k) / (2 * k), 0.0, 1.0)
+                       * 255).astype(np.uint8)
         self.mean, self.std = mean, std
 
     def __len__(self) -> int:

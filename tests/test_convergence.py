@@ -55,12 +55,16 @@ class TinyCNN(nn.Module):
 
 @pytest.fixture(scope="module")
 def task():
-    """Learnable task: class prototypes + noise."""
+    """Learnable task: class prototypes + noise, a POOL of samples from
+    which each step draws a fresh batch (varying batches are the realistic
+    regime — error feedback must average over the stream, not memorize one
+    batch)."""
     rng = np.random.RandomState(0)
     protos = rng.randn(CLASSES, 16, 16, 3).astype(np.float32)
-    labels = rng.randint(0, CLASSES, W * BS).astype(np.int32)
+    n = 1024
+    labels = rng.randint(0, CLASSES, n).astype(np.int32)
     images = (protos[labels]
-              + 0.3 * rng.randn(W * BS, 16, 16, 3)).astype(np.float32)
+              + 0.3 * rng.randn(n, 16, 16, 3)).astype(np.float32)
     return jnp.asarray(images), jnp.asarray(labels)
 
 
@@ -94,8 +98,11 @@ def _train(memory, compress_ratio, task, mesh, dense=False, steps=STEPS):
                         dist_opt=dist)
     step = build_train_step(apply_fn, dist, mesh, flat=setup)
     losses = []
+    npr = np.random.RandomState(99)   # same batch stream for every config
     for i in range(steps):
-        state, m = step(state, images, labels, jax.random.PRNGKey(i))
+        idx = jnp.asarray(npr.randint(0, images.shape[0], W * BS))
+        state, m = step(state, images[idx], labels[idx],
+                        jax.random.PRNGKey(i))
         losses.append(float(m["loss"]))
     return losses
 
