@@ -76,6 +76,7 @@ class ParamLayout:
         dense = [n for n in named if n not in set(compressed_names)]
         self.names: List[str] = compressed + dense
         self.compressed_names = compressed
+        self.dense_names = dense
         self.shapes = {n: tuple(named[n].shape) for n in self.names}
         self.sizes = {n: int(np.prod(self.shapes[n], dtype=np.int64))
                       for n in self.names}
@@ -127,8 +128,7 @@ class ParamLayout:
         if self.t_compressed > self.t_data:
             parts.append(jnp.zeros((self.t_compressed - self.t_data,),
                                    self.dtype))
-        parts += [jnp.ravel(named[n]) for n in self.names
-                  if n not in set(self.compressed_names)]
+        parts += [jnp.ravel(named[n]) for n in self.dense_names]
         if self.total > self.p_data_end:
             parts.append(jnp.zeros((self.total - self.p_data_end,),
                                    self.dtype))
@@ -342,6 +342,20 @@ class FlatDGCEngine:
                 grad, mmt, vec, m.momentum, m.nesterov)
         return vec, mmt, vec
 
+    def _clip_block(self, block: jax.Array, names: Sequence[str],
+                    base: int) -> jax.Array:
+        """Per-tensor gradient clipping over a flat block: the memory's
+        ``gradient_clipping`` callable applied to each named 1-D tensor view
+        (reference memory.py:52-53). Segments are disjoint static slices, so
+        gap/sentinel slots are never touched and stay structural zeros."""
+        clip = self._mem.gradient_clipping
+        lay = self.layout
+        for n in names:
+            s = lay.offsets[n] - base
+            e = s + lay.sizes[n]
+            block = block.at[s:e].set(clip(block[s:e]))
+        return block
+
     def _compensate_dense(self, mmt, grad):
         """Non-accumulating correction for the dense-fallback block, applied
         after averaging (reference compression.py:198, memory.py:64-70)."""
@@ -482,11 +496,7 @@ class FlatDGCEngine:
         ``name in attributes`` guard."""
         T, P = self.T, self.layout.total
         m = self._mem
-        if m is not None and m.gradient_clipping is not None:
-            raise NotImplementedError(
-                "per-tensor gradient clipping requires the per-tensor "
-                "path: build the train step without flat= (it uses "
-                "DistributedOptimizer.exchange per tensor)")
+        clip = m.gradient_clipping if m is not None else None
 
         # ratio >= 1.0 (or nothing initialized): everything dense, with the
         # per-tensor path's non-accumulating correction (dgc.py compress
@@ -495,6 +505,8 @@ class FlatDGCEngine:
             avg = self._dense_combine(flat_grad, axis_name, world_size, op)
             if m is None:
                 return avg, mem
+            if clip is not None:
+                avg = self._clip_block(avg, self.layout.names, 0)
             out, md = self._compensate_dense(mem["momentums"], avg)
             return out, {"momentums": md, "velocities": mem["velocities"]}
 
@@ -507,6 +519,10 @@ class FlatDGCEngine:
 
         # --- compressed block: compensate -> sparsify -> mask -> gather ---
         if m is not None:
+            if clip is not None:
+                # clipping runs on the LOCAL gradient inside the accumulating
+                # compensate (reference memory.py:52-53)
+                gc = self._clip_block(gc, self.layout.compressed_names, 0)
             comp, mc, vc = self._compensate_acc(mc, vc, gc)
         else:
             comp = gc
@@ -532,6 +548,10 @@ class FlatDGCEngine:
         # --- dense fallback block: one collective + correction ---
         if P > T:
             gd_avg = self._dense_combine(gd, axis_name, world_size, op)
+            if clip is not None:
+                # the fallback's compensate sees the AVERAGED gradient
+                # (reference compression.py:198 -> memory.py:52-53)
+                gd_avg = self._clip_block(gd_avg, self.layout.dense_names, T)
             out_d, md = self._compensate_dense(md, gd_avg)
             out = jnp.concatenate([out_c, out_d])
         else:

@@ -58,6 +58,11 @@ def test_dgc_flag_combo_runs_a_step(mesh8, flag, monkeypatch):
     # flag semantics actually took effect
     if flag == "fp16":
         assert comp.fp16_values
+    if flag == "int32":
+        # int32_indices is already the compressor default on TPU; assert the
+        # flag module's assignment actually landed in the config tree
+        assert configs.train.compression.int32_indices is True
+        assert comp.int32_indices
     if flag == "nm":
         assert not memory.momentum_masking
     if flag == "mm":

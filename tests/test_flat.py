@@ -398,3 +398,81 @@ def test_shard_state_rejects_conflicting_flags():
     dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=1)
     with pytest.raises(ValueError, match="not both"):
         shard_state(state, make_mesh(1), per_worker_opt=True, dist_opt=dist)
+
+
+@pytest.mark.parametrize("global_clip", [False, True])
+def test_flat_gradient_clipping_matches_per_tensor(mesh8, global_clip):
+    """A gradient_clipping hook plugged into DGCSGDMemory (reference
+    memory.py:34,52-53) must behave identically on the flat engine and the
+    per-tensor oracle: clip the LOCAL grad inside the accumulating
+    compensate and the AVERAGED grad on the dense fallback. Covers both a
+    local clip and the psum-backed global-norm clip (clip_grad.py:35-42)."""
+    import functools
+
+    from dgc_tpu.utils.clip_grad import (clip_grad_norm,
+                                         clip_grad_norm_2_by_global)
+
+    params = _params()
+    named, _ = named_flatten(params)
+    if global_clip:
+        clip = functools.partial(clip_grad_norm_2_by_global, max_norm=0.05,
+                                 axis_name="data")
+    else:
+        clip = functools.partial(clip_grad_norm, max_norm=0.05)
+
+    def make():
+        comp = DGCCompressor(
+            0.05, memory=DGCSGDMemory(momentum=0.9, gradient_clipping=clip),
+            sample_ratio=1.0)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        return comp, DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                          world_size=W)
+
+    _, dist_f = make()
+    _, dist_p = make()
+    layout, engine = dist_f.make_flat(params)
+
+    rng = np.random.RandomState(21)
+    grads_w = {n: jnp.asarray(rng.randn(W, *p.shape), jnp.float32)
+               for n, p in named.items()}
+    from dgc_tpu.utils.pytree import named_unflatten
+    flat_grads_w = jnp.stack([
+        layout.flatten(named_unflatten({n: grads_w[n][w] for n in named},
+                                       named_flatten(params)[1]))
+        for w in range(W)])
+
+    flat_fn = _flat_exchange_fn(dist_f, engine, mesh8)
+    pt_fn = _pt_exchange_fn(dist_p, mesh8)
+    mem_f = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         engine.init_memory())
+    mem_p = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         dist_p.init_memory(params))
+
+    clipped_any = False
+    for step in range(3):
+        key = jax.random.PRNGKey(step)
+        out_f, mem_f = flat_fn(flat_grads_w, mem_f, key)
+        out_p, mem_p = pt_fn(grads_w, mem_p, key)
+        named_out_p, _ = named_flatten(out_p)
+        named_out_f = layout.unflatten_named(out_f[0])
+        for n in layout.names:
+            np.testing.assert_allclose(
+                np.asarray(named_out_f[n]).reshape(-1),
+                np.asarray(named_out_p[n][0]).reshape(-1),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"exchanged grads step {step} {n}")
+        for mkey in ("momentums", "velocities"):
+            named_m_f = layout.unflatten_named(mem_f[mkey][0], keep_1d=True)
+            for n in layout.names:
+                np.testing.assert_allclose(
+                    np.asarray(named_m_f[n]),
+                    np.asarray(mem_p[mkey][n][0]).reshape(-1),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"{mkey} step {step} {n}")
+        # the clip must actually engage: raw grads have norm >> 0.05
+        for n in layout.compressed_names:
+            seg = np.asarray(mem_f["momentums"][0])[
+                layout.offsets[n]:layout.offsets[n] + layout.sizes[n]]
+            if np.linalg.norm(seg) < 1.0:
+                clipped_any = True
+    assert clipped_any
