@@ -1,0 +1,16 @@
+"""bfloat16 compute — TPU extension (no reference counterpart).
+
+The MXU runs matmuls/convs natively in bfloat16; composing this flag after a
+model config makes activations and conv/dense compute bf16 while parameters,
+gradients, the optimizer, and the entire compression pipeline stay float32 —
+the DGC numerics contract (SURVEY.md §2) is untouched.
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/bf16.py
+"""
+
+import jax.numpy as jnp
+
+from dgc_tpu.utils.config import configs
+
+configs.model.dtype = jnp.bfloat16

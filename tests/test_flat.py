@@ -57,16 +57,23 @@ def test_layout_roundtrip():
     flat = layout.flatten(params)
     assert flat.shape == (layout.total,)
     assert layout.num_params == sum(p.size for p in named.values())
-    # compressed block is the aligned prefix; the gap holds the sentinel
-    t_data = sum(named[n].size for n in compressed)
-    assert layout.t_data == t_data
-    assert layout.sentinel == t_data
-    assert layout.t_compressed >= t_data + 1
+    # compressed block is the row-aligned prefix; the gap holds the sentinel
+    t_real = sum(named[n].size for n in compressed)
+    assert layout.t_data >= t_real          # row tails are structural pads
+    assert layout.sentinel == layout.t_data
+    assert layout.t_compressed >= layout.t_data + 1
     assert layout.t_compressed % 1024 == 0 and layout.total % 1024 == 0
-    # gaps are structural zeros
+    # every compressed tensor sits inside exactly one bucket row
+    for g in layout.buckets:
+        for r, n in enumerate(g.names):
+            assert layout.offsets[n] == g.base + r * g.cols
+            assert layout.sizes[n] <= g.cols
+    # every slot not covered by a real tensor is a structural zero
     fl = np.asarray(flat)
-    assert (fl[layout.t_data:layout.t_compressed] == 0).all()
-    assert (fl[layout.p_data_end:] == 0).all()
+    covered = np.zeros((layout.total,), bool)
+    for n in layout.names:
+        covered[layout.offsets[n]:layout.offsets[n] + layout.sizes[n]] = True
+    assert (fl[~covered] == 0).all()
     back = layout.unflatten(flat)
     for n, p in named_flatten(back)[0].items():
         np.testing.assert_array_equal(np.asarray(p), np.asarray(named[n]))

@@ -96,7 +96,8 @@ def test_flat_sparsify_with_adaptation_transmits_enough():
     layout, engine = dist.make_flat(params)
     a = comp.attributes["w"]
     vec = np.zeros((layout.t_compressed,), np.float32)
-    vec[:layout.t_data] = base.reshape(-1)
+    off = layout.offsets["w"]
+    vec[off:off + layout.sizes["w"]] = base.reshape(-1)
     vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
                                          jax.random.PRNGKey(0))
     valid = np.asarray(idx) < layout.t_data

@@ -106,3 +106,52 @@ def test_meters():
     m2 = TopKClassMeter(k=2)
     m2.set({k: v * 4 for k, v in data.items()})  # simulated Sum-allreduce
     assert m2.compute() == 100.0
+
+
+@pytest.mark.parametrize("ctor,shape", [
+    (resnet20, (32, 32)), (resnet18, (56, 56)), (vgg16_bn, (224, 224))])
+def test_bf16_compute_keeps_f32_params_and_logits(ctor, shape):
+    """configs/bf16.py contract: dtype=bfloat16 switches COMPUTE only —
+    parameters stay float32 (so the compression pipeline sees f32 grads)
+    and logits come back float32."""
+    model = ctor(num_classes=10, dtype=jnp.bfloat16)
+    x = jnp.zeros((2, *shape, 3), jnp.float32)
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    for leaf in jax.tree.leaves(v["params"]):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    out = model.apply(v, x, train=False)
+    assert out.dtype == jnp.float32
+
+
+def test_bf16_dgc_train_step(mesh8):
+    """Full DGC train step with a bf16-compute model on the 8-way mesh:
+    runs, loss finite, f32 gradients flow through the flat engine."""
+    from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer, dgc_sgd
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+    from dgc_tpu.utils.pytree import named_flatten
+
+    W = 8
+    model = resnet20(num_classes=10, dtype=jnp.bfloat16)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                   train=True)
+    named, _ = named_flatten(v["params"])
+    comp = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    setup = make_flat_setup(v, dist)
+    assert setup.layout.dtype == np.float32
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh8,
+                        dist_opt=dist)
+    step = build_train_step(model.apply, dist, mesh8, flat=setup)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(W * 2, 32, 32, 3), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, W * 2), jnp.int32)
+    losses = []
+    for i in range(4):
+        state, m = step(state, images, labels, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert state.params.dtype == jnp.float32
