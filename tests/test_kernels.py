@@ -102,3 +102,65 @@ def test_flat_sparsify_with_adaptation_transmits_enough():
                                          jax.random.PRNGKey(0))
     valid = np.asarray(idx) < layout.t_data
     assert valid.sum() >= int(0.8 * a.num_selects) - 1
+
+
+@pytest.mark.parametrize("shape,k", [((8, 256), 1), ((8, 256), 37),
+                                     ((5, 300), 10), ((16, 1024), 40),
+                                     ((8, 128), 128)])
+def test_topk_rows_matches_lax_top_k(shape, k):
+    """topk_rows must equal jax.lax.top_k exactly: descending values, ties
+    broken by first occurrence — on aligned and ragged shapes."""
+    from dgc_tpu.ops.kernels import topk_rows
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    v, i = topk_rows(x, k)
+    v_ref, i_ref = jax.lax.top_k(x, k)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_topk_rows_tie_order():
+    """Duplicated values must come out in ascending index order, exactly as
+    lax.top_k orders them."""
+    from dgc_tpu.ops.kernels import topk_rows
+
+    x = jnp.asarray([[1.0, 3.0, 3.0, 0.0, 3.0, -1.0, 2.0, 2.0]] * 8,
+                    jnp.float32)
+    v, i = topk_rows(x, 6)
+    v_ref, i_ref = jax.lax.top_k(x, 6)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_topk_rows_fallback_large():
+    """Rows beyond the VMEM budget (or k > lane width) fall back to
+    lax.top_k and stay correct."""
+    from dgc_tpu.ops.kernels import topk_rows
+
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(2, 2 * 1024 * 1024 // 8), jnp.float32)
+    v, i = topk_rows(x, 5)
+    v_ref, i_ref = jax.lax.top_k(x, 5)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+    x2 = jnp.asarray(rng.randn(4, 512), jnp.float32)
+    v2, i2 = topk_rows(x2, 200)       # k > lane width
+    v2_ref, i2_ref = jax.lax.top_k(x2, 200)
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(v2_ref))
+
+
+def test_topk_rows_with_neg_inf_entries():
+    """Rows containing real -inf values (and k reaching into them) must
+    still match lax.top_k exactly: ascending-index extraction over the
+    remaining -inf slots, no duplicate indices."""
+    from dgc_tpu.ops.kernels import topk_rows
+
+    ninf = -np.inf
+    x = jnp.asarray([[5.0, ninf, 3.0, ninf, 1.0, 0.0, -1.0, 2.0]] * 8,
+                    jnp.float32)
+    v, i = topk_rows(x, 8)
+    v_ref, i_ref = jax.lax.top_k(x, 8)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+    assert len(set(np.asarray(i)[0].tolist())) == 8  # no duplicates
