@@ -79,7 +79,7 @@ class DGCCompressor(Compressor):
                  max_adaptation_iters: int = 10, resample: bool = True,
                  fp16_values: bool = False, int32_indices: bool = True,
                  warmup_epochs: int = -1, warmup_coeff=None,
-                 verbose: bool = False):
+                 approx_recall: float = 0.95, verbose: bool = False):
         self.fp16_values = fp16_values
         # Indices are int32 natively on TPU (XLA default; int64 requires x64
         # mode and doubles wire traffic). The flag is kept for config parity
@@ -111,6 +111,14 @@ class DGCCompressor(Compressor):
         self.compress_lower_bound = compress_lower_bound
         self.max_adaptation_iters = max_adaptation_iters
         self.resample = resample
+        #: recall target for the flat engine's large-bucket selection
+        #: (lax.approx_max_k when num_selects exceeds the lane width);
+        #: None forces exact top-k everywhere. The exact sort-based TopK is
+        #: 10-50x slower at ImageNet-scale k and crashes the v5e compiler
+        #: at the largest shapes; missed coordinates stay in the
+        #: error-feedback velocity (the same guarantee that covers the
+        #: reference's index-order truncation, compression.py:151).
+        self.approx_recall = approx_recall
         self.verbose = verbose
 
         self.attributes: Dict[str, TensorAttrs] = {}

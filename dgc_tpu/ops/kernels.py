@@ -143,9 +143,11 @@ _LADDER_COL_CHUNK = 128 * 1024
 def ladder_cols(max_n: int) -> int:
     """Padded row width the ladder kernel requires: lane-aligned, and a
     multiple of the column chunk once chunking kicks in (ragged column
-    blocks would read unspecified values into the counts). The engine bakes
-    this width into its sentinel row maps so no device-side padding copy is
-    ever made."""
+    blocks would read unspecified values into the counts). The engine's
+    layout bakes this width into its bucket tiles so columns never need a
+    device-side pad; ROWS are deliberately unpadded in storage (padding
+    them would inflate every persistent buffer, flat._BucketGeom) and pay
+    one small in-trace pad here instead."""
     cols = _round_up(max_n, _LANE)
     if cols > _LADDER_COL_CHUNK:
         cols = _round_up(cols, _LADDER_COL_CHUNK)
@@ -182,8 +184,11 @@ def ladder_counts(imp_rows: jax.Array, thr: jax.Array, lower_bound: float,
 
     Grid: (row blocks of 8) x (column chunks); the [8, 128]-int32 output
     block is revisited across column chunks and accumulated. Inputs that
-    are not (8, ladder_cols)-aligned pay one pad copy — the engine passes
-    pre-aligned sentinel views so the hot path never does."""
+    are not (8, ladder_cols)-aligned pay one in-trace pad copy; the
+    engine's bucket views are column-aligned by construction but
+    deliberately row-unpadded (see flat._BucketGeom), so adaptive buckets
+    pay the small row pad here each step rather than inflating every
+    persistent buffer."""
     assert levels <= _LANE
     R, maxN = imp_rows.shape
     rpad = (-R) % _SUBLANE
@@ -238,18 +243,20 @@ def _topk_kernel(x_ref, v_ref, i_ref, *, k, cols):
         # an explicit taken-mask (rather than overwriting extracted slots
         # with -inf) keeps rows containing real -inf entries correct: once
         # only -inf remains, extraction still proceeds in ascending index
-        # order over untaken slots, matching lax.top_k exactly
-        m = jnp.max(jnp.where(taken, -jnp.inf, x), axis=1,
+        # order over untaken slots, matching lax.top_k exactly. The mask is
+        # carried as int32 — Mosaic cannot legalize an i1 vector loop carry.
+        free = taken == 0
+        m = jnp.max(jnp.where(free, x, -jnp.inf), axis=1,
                     keepdims=True)                        # [8, 1]
         # first untaken index attaining the max (lax.top_k's tie order)
-        idx = jnp.min(jnp.where(~taken & (x >= m), lane, cols), axis=1,
+        idx = jnp.min(jnp.where(free & (x >= m), lane, cols), axis=1,
                       keepdims=True)                      # [8, 1]
         v = jnp.where(out_lane == j, m, v)
         i = jnp.where(out_lane == j, idx, i)
-        return taken | (lane == idx), v, i
+        return jnp.where(lane == idx, 1, taken), v, i
 
     _, v, i = jax.lax.fori_loop(
-        0, k, body, (jnp.zeros(x.shape, bool),
+        0, k, body, (jnp.zeros(x.shape, jnp.int32),
                      jnp.full((x.shape[0], _LANE), -jnp.inf, x.dtype),
                      jnp.zeros((x.shape[0], _LANE), jnp.int32)))
     v_ref[:] = v
@@ -273,8 +280,11 @@ def topk_rows(x: jax.Array, k: int):
     to ``lax.top_k`` when k exceeds the lane width or a row block exceeds
     the VMEM budget. Non-lane-aligned widths pay one -inf pad copy."""
     R, cols = x.shape
-    if k > _LANE or 8 * _round_up(cols, _LANE) * x.dtype.itemsize \
-            > _TOPK_VMEM_BYTES:
+    # k > cols delegates so lax.top_k raises its usual error; k > _LANE
+    # exceeds the [8, 128] output block; oversized rows exceed VMEM
+    if (k > _LANE or k > cols
+            or 8 * _round_up(cols, _LANE) * x.dtype.itemsize
+            > _TOPK_VMEM_BYTES):
         return jax.lax.top_k(x, k)
     rpad = (-R) % _SUBLANE
     cpad = (-cols) % _LANE

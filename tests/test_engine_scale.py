@@ -76,3 +76,36 @@ def test_resnet50_exchange_one_step():
     assert 0 < nz <= 2 * engine.payload_size
     # residual accumulated for untransmitted coords
     assert np.abs(np.asarray(mem["velocities"])[:layout.t_data]).sum() > 0
+
+
+def test_approx_recall_knob():
+    """approx_recall defaults to 0.95 and None forces exact top-k — on CPU
+    approx_max_k lowers to exact, so both settings must select identically
+    (the gate itself only changes the op choice at num_selects > 128)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer, dgc_sgd
+
+    assert DGCCompressor(0.01).approx_recall == 0.95
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(600, 600), jnp.float32)}
+
+    def run(recall):
+        comp = DGCCompressor(0.5, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0, approx_recall=recall)
+        comp.initialize([("w", params["w"])])
+        assert comp.attributes["w"].num_selects > 128  # approx gate engages
+        dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+        _, engine = dist.make_flat(params)
+        vec = jnp.zeros((engine.layout.t_compressed,), jnp.float32)
+        vec = vec.at[:360000].set(jnp.asarray(rng.randn(360000), jnp.float32))
+        return jax.jit(engine.sparsify)(vec, jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(0)
+    va, ia = run(0.95)
+    rng = np.random.RandomState(0)
+    ve, ie = run(None)
+    np.testing.assert_array_equal(np.asarray(ia), np.asarray(ie))
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(ve))

@@ -164,3 +164,13 @@ def test_topk_rows_with_neg_inf_entries():
     np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
     np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
     assert len(set(np.asarray(i)[0].tolist())) == 8  # no duplicates
+
+
+def test_topk_rows_k_exceeding_cols_raises_like_lax():
+    """cols < k <= lane width must not silently return pad indices — the
+    guard delegates to lax.top_k, which raises."""
+    from dgc_tpu.ops.kernels import topk_rows
+
+    x = jnp.zeros((8, 100), jnp.float32)
+    with pytest.raises(ValueError):
+        topk_rows(x, 110)
