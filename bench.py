@@ -10,8 +10,9 @@ uses) at the 32-worker configuration row of BASELINE.json — since only one
 TPU chip is attached here. All inputs to the model are printed to stderr.
 
   dense exchange = ring-allreduce wire: 2 * 4B * P * (W-1)/W / BW
-  dgc   exchange = measured step overhead (dgc_step - dense_step, >=0)
-                 + allgather wire: (W-1) * payload * 8B / BW
+  dgc   exchange = measured step overhead (median over interleaved rounds
+                   of the within-round difference dgc_step_r - dense_step_r,
+                   clamped >= 0) + allgather wire: (W-1) * payload * 8B / BW
   vs_baseline    = dense_exchange / dgc_exchange   (>1 means DGC wins;
                    the reference's stated target is >=2)
 
@@ -30,6 +31,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import statistics
 import sys
 import time
 
@@ -72,24 +74,28 @@ def _make_k_loop(step_fn, images, labels, k):
     return k_loop
 
 
-def _interleaved_step_ms(runs, rtt_ms, k=K_STEPS, repeats=4):
+def _interleaved_step_ms(runs, rtt_ms, k=K_STEPS, repeats=8):
     """Per-step device time for several (k_loop, state) configs, with the
     timed rounds INTERLEAVED so slow drift in the relay link hits every
     config equally (back-to-back runs minutes apart drift by more than the
-    differences being measured). Returns min-over-rounds per config."""
-    states, best = [], [None] * len(runs)
+    differences being measured). Returns the per-round rows — consumers
+    compare configs with the PAIRED per-round values (median of
+    within-round differences), which cancels drift far better than
+    differencing each config's independent minimum."""
+    states, rows = [], []
     for k_loop, state in runs:
         state, _ = k_loop(state, jax.random.PRNGKey(0))   # compile + warm
         _ = float(_ssum(state.params))
         states.append(state)
     for r in range(repeats):
+        row = []
         for j, (k_loop, _) in enumerate(runs):
             t0 = time.perf_counter()
             states[j], _ = k_loop(states[j], jax.random.PRNGKey(1 + r))
             _ = float(_ssum(states[j].params))   # blocks until all K ran
-            ms = ((time.perf_counter() - t0) * 1e3 - rtt_ms) / k
-            best[j] = ms if best[j] is None else min(best[j], ms)
-    return best
+            row.append(((time.perf_counter() - t0) * 1e3 - rtt_ms) / k)
+        rows.append(row)
+    return rows
 
 
 def main():
@@ -145,9 +151,15 @@ def main():
     dense_run, _ = prepare(DistributedOptimizer(
         sgd(0.1, momentum=0.9, weight_decay=1e-4), Compression.none(),
         world_size=W))
-    dgc_ms, dense_ms = _interleaved_step_ms([dgc_run, dense_run], rtt)
+    rows = _interleaved_step_ms([dgc_run, dense_run], rtt)
+    dgc_ms, dense_ms = (min(col) for col in zip(*rows))
     print(f"dgc step (flat engine): {dgc_ms:.3f} ms", file=sys.stderr)
     print(f"dense step (flat):      {dense_ms:.3f} ms", file=sys.stderr)
+    # paired within-round differences cancel link drift
+    diffs = sorted(d - b for d, b in rows)
+    overhead = statistics.median(diffs)
+    print(f"per-round overheads: {[round(x, 3) for x in diffs]} "
+          f"-> median {overhead:.4f} ms", file=sys.stderr)
 
     # --- exchange model on the reference fabric ---
     P_total = dgc_setup.layout.num_params
@@ -156,7 +168,7 @@ def main():
     dense_wire_ms = (2 * 4 * P_total * (Wf - 1) / Wf) / (
         FABRIC_GBPS * 1e9) * 1e3
     dgc_wire_ms = ((Wf - 1) * payload * 8) / (FABRIC_GBPS * 1e9) * 1e3
-    dgc_overhead_ms = max(dgc_ms - dense_ms, 0.0)
+    dgc_overhead_ms = max(overhead, 0.0)
 
     dense_exchange = dense_wire_ms
     dgc_exchange = dgc_overhead_ms + dgc_wire_ms
