@@ -156,7 +156,7 @@ def main():
     print(f"dgc step (flat engine): {dgc_ms:.3f} ms", file=sys.stderr)
     print(f"dense step (flat):      {dense_ms:.3f} ms", file=sys.stderr)
     # paired within-round differences cancel link drift
-    diffs = sorted(d - b for d, b in rows)
+    diffs = [d - b for d, b in rows]      # chronological, for drift triage
     overhead = statistics.median(diffs)
     print(f"per-round overheads: {[round(x, 3) for x in diffs]} "
           f"-> median {overhead:.4f} ms", file=sys.stderr)

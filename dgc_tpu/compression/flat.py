@@ -33,14 +33,13 @@ wire volume matches the reference's exactly), scatter-add-then-average
 decompress, momentum correction and masking per SURVEY.md §2.3-2.5.
 """
 
-import math
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dgc_tpu.compression.memory import DGCSGDMemory, Memory
+from dgc_tpu.compression.memory import DGCSGDMemory
 from dgc_tpu.ops import kernels
 from dgc_tpu.utils.pytree import named_flatten, named_unflatten
 

@@ -17,7 +17,7 @@ runnable on machines without the datasets.
 
 import os
 import pickle
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import numpy as np
 

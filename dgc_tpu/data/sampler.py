@@ -10,7 +10,7 @@ the device one contiguous global batch whose leading axis shards over the
 mesh without a gather.
 """
 
-from typing import Iterator, List
+from typing import Iterator
 
 import numpy as np
 

@@ -17,7 +17,7 @@ is no shared memory), which is still the reference's own data path (its GPU
 grads go through Horovod's CPU/MPI staging for large payloads).
 """
 
-from typing import Dict, Iterable, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

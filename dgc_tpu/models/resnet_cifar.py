@@ -14,7 +14,7 @@ kaiming-normal (fan_out) conv init matching torchvision's recipe.
 """
 
 from functools import partial
-from typing import Any, Callable, Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ every op has a static shape:
   bitwise — SURVEY.md "hard parts" #4).
 """
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

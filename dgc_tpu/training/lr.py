@@ -12,7 +12,6 @@ optimizer transformation, so per-step warm-up needs no host-side mutation of
 optimizer state.
 """
 
-import math
 from typing import Callable, Optional, Sequence
 
 import jax.numpy as jnp

@@ -10,7 +10,6 @@ trivially convertible; if tensorboardX is importable it is used additionally.
 
 import json
 import os
-from typing import Optional
 
 __all__ = ["MetricWriter", "printr"]
 

@@ -16,7 +16,7 @@ wall-clock speedup figure (README.md:24-25). The TPU build does better:
 
 import contextlib
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 import jax
 import numpy as np

@@ -19,7 +19,6 @@ TPU-native differences by design:
 """
 
 import argparse
-import math
 import os
 import time
 
