@@ -78,7 +78,7 @@ class DGCCompressor(Compressor):
                  compress_lower_bound: float = 0.8,
                  max_adaptation_iters: int = 10, resample: bool = True,
                  fp16_values: bool = False, int32_indices: bool = True,
-                 warmup_epochs: int = -1, warmup_coeff=None,
+                 warmup_epochs: int = -1, warmup_coeff=None, *,
                  approx_recall: float = 0.95, verbose: bool = False):
         self.fp16_values = fp16_values
         # Indices are int32 natively on TPU (XLA default; int64 requires x64

@@ -89,9 +89,13 @@ class ParamLayout:
     across warm-up ratio changes (reference compression.py:91-107).
     """
 
-    #: row-padding budget of a size bucket: a tensor joins the current
-    #: bucket while max_numel/numel <= this (see _group_by_size)
-    PAD_FACTOR = 2.0
+    #: bucket-count/padding exchange rate for _group_by_size's partition
+    #: DP: one extra bucket costs fixed per-step op floors (sample slice,
+    #: threshold top-k, selection — measured ~0.2-0.4 ms each on v5e),
+    #: while one padded slot costs ~0.1 ns/step of extra bandwidth across
+    #: the full-pass stages plus 4-5 buffers of storage. 2M slots/bucket is
+    #: the measured break-even within a factor of ~2 either way
+    FLOOR_SLOTS = 2_000_000
 
     def __init__(self, tree, compressed_names: Sequence[str] = ()):
         named, self.treedef = named_flatten(tree)
@@ -135,22 +139,50 @@ class ParamLayout:
             off += self.sizes[n]
         self.p_data_end = off
         self.total = _round_up(off, _ALIGN) if off else 0
+        # Wire indices are int32 (the reference's int32_indices flag is
+        # always-on here — dgc.py __init__); a flat buffer at or above 2**31
+        # elements (~8 GiB fp32 of parameters) would overflow them. The
+        # BASELINE "int64 idx" config row anticipates this scale — reaching
+        # it needs an int64 index wire format, not a silent wrap.
+        if self.total >= 2 ** 31:
+            raise ValueError(
+                f"flat layout has {self.total} slots >= 2**31: int32 wire "
+                "indices would overflow (BASELINE 'int64 idx' row); shard "
+                "the model or add an int64 index path")
         # insertion order of `named` (the treedef leaf order), for unflatten
         self._tree_order = list(named)
 
     def _group_by_size(self, compressed: Sequence[str]) -> List[List[str]]:
-        """Sort by numel descending, cut a new bucket when padding a tensor
-        to the bucket's row width would exceed PAD_FACTOR."""
+        """Partition the size-sorted tensors into contiguous buckets by an
+        exact O(n^2) DP minimizing ``FLOOR_SLOTS * #buckets + padded
+        slots`` — the measured per-step trade between per-bucket op floors
+        and the bandwidth/storage cost of row padding. Big tensors stay in
+        tight buckets (padding a 1M-row to 2.4M costs more than a bucket
+        floor); the small-tensor tail collapses into few buckets (its
+        padding is absolutely cheap, the floors are not)."""
         names = sorted(compressed, key=lambda n: -self.sizes[n])
+        n = len(names)
+        if n == 0:
+            return []
+        sizes = [self.sizes[x] for x in names]
+        best = [float("inf")] * (n + 1)
+        best[n] = 0.0
+        cut = [n] * (n + 1)
+        for i in range(n - 1, -1, -1):
+            cols = kernels.ladder_cols(sizes[i])
+            pad = 0
+            for j in range(i, n):
+                pad += cols - sizes[j]
+                c = self.FLOOR_SLOTS + pad + best[j + 1]
+                if c < best[i]:
+                    best[i] = c
+                    cut[i] = j + 1
         groups: List[List[str]] = []
-        bucket_max = None
-        for n in names:
-            sz = self.sizes[n]
-            if bucket_max is None or sz * self.PAD_FACTOR < bucket_max:
-                groups.append([])
-                bucket_max = sz
-            groups[-1].append(n)
-        return [g for g in groups if g]
+        i = 0
+        while i < n:
+            groups.append(names[i:cut[i]])
+            i = cut[i]
+        return groups
 
     @classmethod
     def for_compressor(cls, tree, compressor) -> "ParamLayout":
@@ -233,6 +265,11 @@ class _Bucket(NamedTuple):
     exact: bool                # every row samples its whole tensor
     tight: np.ndarray          # [payload] positions into the [R*max_sel] grid
     payload: int
+    #: runs of consecutive rows sharing a sample stride: (r0, r1, stride, n)
+    #: with n = max num_samples in the run — the strided sample of such a
+    #: run is ONE dynamic_slice of the [Rg, n, stride] reshape (see
+    #: sparsify)
+    stride_groups: Tuple[Tuple[int, int, int, int], ...]
 
 
 def _build_buckets(attributes, layout: ParamLayout) -> List[_Bucket]:
@@ -246,6 +283,15 @@ def _build_buckets(attributes, layout: ParamLayout) -> List[_Bucket]:
         tight = np.concatenate([
             np.arange(r * max_sel, r * max_sel + k, dtype=np.int64)
             for r, k in enumerate(num_selects)])
+        strides_np = np.array([a.sample_stride for a in attrs], np.int32)
+        samples_np = np.array([a.num_samples for a in attrs], np.int32)
+        stride_groups = []
+        r0 = 0
+        for r in range(1, g.rows + 1):
+            if r == g.rows or strides_np[r] != strides_np[r0]:
+                stride_groups.append((r0, r, int(strides_np[r0]),
+                                      int(samples_np[r0:r].max())))
+                r0 = r
         buckets.append(_Bucket(
             base=g.base,
             rows=g.rows,
@@ -265,8 +311,21 @@ def _build_buckets(attributes, layout: ParamLayout) -> List[_Bucket]:
             exact=all(a.num_samples >= a.numel for a in attrs),
             tight=tight,
             payload=int(num_selects.sum()),
+            stride_groups=tuple(stride_groups),
         ))
     return buckets
+
+
+def _exact_topk(x: jax.Array, k: int):
+    """Exact per-row top-k: the Pallas iterative-max kernel on TPU (bitwise
+    lax.top_k-compatible, kernels.topk_rows — it self-gates on k <= lane
+    width and VMEM budget and falls back to lax.top_k otherwise; measured
+    faster than XLA's sort-based lowering at the engine's small-k shapes,
+    e.g. 0.42 -> ~0.1 ms on a [19, 65536] k=66 bucket), plain lax.top_k
+    elsewhere (the interpreter would be slower than the native sort)."""
+    if kernels.use_pallas():
+        return kernels.topk_rows(x, k)
+    return jax.lax.top_k(x, k)
 
 
 def _ladder_adapt(imp_rows, thr, num_selects, adapt_mask, lower,
@@ -352,19 +411,55 @@ class FlatDGCEngine:
         return m if isinstance(m, DGCSGDMemory) else None
 
     def init_memory(self) -> Dict:
+        """Error-feedback buffers, stored SPLIT at the compressed/dense
+        boundary T. The two halves live different lives every step (the
+        compressed half goes through compensate/mask, the tail through the
+        non-accumulating correction); storing them pre-split lets the
+        masking multiply write the final state buffers directly instead of
+        materializing masked intermediates that a concat fusion then
+        re-reads — measured ~1.8 ms/step of full-[P] traffic on ResNet-50
+        (v5e). External consumers use :meth:`memory_state_dict` (the
+        reference's per-name checkpoint format, memory.py:79-88), which is
+        layout-agnostic."""
         if self._mem is None:
             return {}
-        z = jnp.zeros((self.layout.total,), self.layout.dtype)
-        return {"momentums": z, "velocities": z}
+        T, P = self.T, self.layout.total
+        zc = jnp.zeros((T,), self.layout.dtype)
+        zd = jnp.zeros((P - T,), self.layout.dtype)
+        # masking is DEFERRED: the step that transmits records its keep
+        # mask (0.0 at transmitted coords); the NEXT step's compensate
+        # applies the zeroing on read, fused into the Pallas kernel
+        # (kernels.fused_compensate_masked) — bitwise identical to eager
+        # masking but it rides the compensate pass instead of costing its
+        # own full-[T] write+read (measured 0.83 ms/step at ResNet-50
+        # scale on v5e). The [T] f32 shape is ratio-independent, so
+        # checkpoints survive warm-up ratio changes. f32 deliberately: a
+        # sub-word (int8) mask would quarter the read bandwidth but its
+        # SCATTER lowers to a serial while-loop on v5e (~2.3 ms/step
+        # measured); the f32 scatter-into-fresh-ones is the fast path.
+        return {"momentums_c": zc, "velocities_c": zc,
+                "momentums_d": zd, "velocities_d": zd,
+                "keep_c": jnp.ones((T,), self.layout.dtype)}
 
-    def _compensate_acc(self, mmt, vec, grad):
+    def _compensate_acc(self, mmt, vec, grad, keep=None):
         """Momentum correction + local accumulation (memory.py:50-63) —
         the fused single-pass Pallas kernel on TPU, its jnp reference
-        elsewhere (bit-compatible, tests/test_kernels.py)."""
+        elsewhere (bit-compatible, tests/test_kernels.py). With ``keep``,
+        the previous step's transmit mask (memory.py:72-77) is applied on
+        read inside the same pass (deferred masking)."""
         m = self._mem
         if m is None:
             return grad, mmt, vec
-        if kernels.use_pallas() and grad.shape[0] > 0:
+        if keep is not None:
+            if kernels.use_pallas() and grad.shape[0] > 0:
+                mmt, vec = kernels.fused_compensate_masked(
+                    grad, mmt, vec, keep, m.momentum, m.nesterov,
+                    m.momentum_masking)
+            else:
+                mmt, vec = kernels.fused_compensate_masked_reference(
+                    grad, mmt, vec, keep, m.momentum, m.nesterov,
+                    m.momentum_masking)
+        elif kernels.use_pallas() and grad.shape[0] > 0:
             mmt, vec = kernels.fused_compensate(grad, mmt, vec, m.momentum,
                                                 m.nesterov)
         else:
@@ -375,15 +470,53 @@ class FlatDGCEngine:
     def _clip_block(self, block: jax.Array, names: Sequence[str],
                     base: int) -> jax.Array:
         """Per-tensor gradient clipping over a flat block: the memory's
-        ``gradient_clipping`` callable applied to each named 1-D tensor view
-        (reference memory.py:52-53). Segments are disjoint static slices, so
-        gap/sentinel slots are never touched and stay structural zeros."""
+        ``gradient_clipping`` callable applied per named tensor
+        (reference memory.py:52-53), batched.
+
+        Whole buckets clip as one ``vmap`` over the [R, cols] row view (a
+        pure reshape) — row tails are structural zeros, and every C7 clip
+        function is *padding-invariant* (appended zeros change no norm and
+        clip back to zero), so per-row == per-tensor. This collapses the
+        global variants' per-tensor ``pmean`` into one [R]-vector collective
+        per bucket and avoids a per-tensor dynamic-update-slice chain at
+        ImageNet scale (50+ tensors). Non-bucket names (the dense tail)
+        batch the same way through a padded [R, C] gather — the dense block
+        is small (biases/BN), so the gather is off the sizing path.
+
+        Custom ``gradient_clipping`` callables must preserve that
+        padding-invariance contract (all reference clip_grad.py:10-42
+        functions do).
+        """
         clip = self._mem.gradient_clipping
         lay = self.layout
-        for n in names:
-            s = lay.offsets[n] - base
-            e = s + lay.sizes[n]
-            block = block.at[s:e].set(clip(block[s:e]))
+        names = list(names)
+        name_set = set(names)
+        done = set()
+        for g in lay.buckets:
+            if not all(n in name_set for n in g.names):
+                continue
+            s = g.base - base
+            view = block[s:s + g.rows * g.cols].reshape(g.rows, g.cols)
+            clipped = jax.vmap(clip)(view)
+            block = block.at[s:s + g.rows * g.cols].set(clipped.reshape(-1))
+            done.update(g.names)
+        rest = [n for n in names if n not in done]
+        if rest:
+            C = max(lay.sizes[n] for n in rest)
+            offs = jnp.asarray([lay.offsets[n] - base for n in rest],
+                               jnp.int32)[:, None]
+            sizes = jnp.asarray([lay.sizes[n] for n in rest],
+                                jnp.int32)[:, None]
+            col = jnp.arange(C, dtype=jnp.int32)[None, :]
+            valid = col < sizes
+            pos = jnp.where(valid, offs + col, 0)
+            rows = jnp.where(valid, block[pos.reshape(-1)].reshape(pos.shape),
+                             jnp.zeros((), block.dtype))
+            rows = jax.vmap(clip)(rows)
+            # invalid slots scatter out of bounds and drop
+            flat_pos = jnp.where(valid, offs + col,
+                                 jnp.int32(block.shape[0])).reshape(-1)
+            block = block.at[flat_pos].set(rows.reshape(-1), mode="drop")
         return block
 
     def _compensate_dense(self, mmt, grad):
@@ -416,10 +549,24 @@ class FlatDGCEngine:
         CPU approx_max_k lowers to an exact sort, so the flat-vs-per-tensor
         equivalence tests see identical selections."""
         r = self.c.approx_recall
-        if r is not None and max_sel > 128:
+        if r is not None and (max_sel > 128 or scores.shape[1] >= 32768):
+            if kernels.use_pallas():
+                # TPU: aggregate_to_topk=False + a manual lax.top_k over
+                # the [R, l] candidate set — same candidates, same recall,
+                # but the built-in aggregation (a variadic sort) measured
+                # 0.53 ms vs 0.09 ms for this split at the ResNet-50
+                # big-bucket shapes on v5e
+                cv, ci = jax.lax.approx_max_k(scores, max_sel,
+                                              recall_target=float(r),
+                                              aggregate_to_topk=False)
+                v2, i2 = jax.lax.top_k(cv, max_sel)
+                return v2, jnp.take_along_axis(ci, i2, axis=1)
+            # CPU/other: the aggregated form falls back to an EXACT sort
+            # (the equivalence suite relies on that); aggregate_to_topk=
+            # False would force the partial-reduce op and lose recall
             return jax.lax.approx_max_k(scores, max_sel,
                                         recall_target=float(r))
-        return jax.lax.top_k(scores, max_sel)
+        return _exact_topk(scores, max_sel)
 
     def sparsify(self, vec_c: jax.Array, key: jax.Array):
         """Sampled-top-k selection over the compressed block [T].
@@ -478,16 +625,71 @@ class FlatDGCEngine:
                 continue
 
             # --- sampling positions (reference compression.py:113-121) ---
-            s_idx = jnp.arange(b.max_s, dtype=jnp.int32)[None, :]
-            s_valid = s_idx < jnp.asarray(b.num_samples)[:, None]
+            neg1 = jnp.full((), -1.0, vec_c.dtype)
             if self.c.strided_sample:
-                strides = jnp.asarray(b.strides)[:, None]
-                # random phase in [0, stride) per row; stride-1 rows (the
-                # sample-everything degenerate path) get phase 0 = exact
-                u = jax.random.uniform(k, (R, 1))
-                phase = jnp.floor(u * strides).astype(jnp.int32)
-                pos = phase + s_idx * strides
+                # TPU-native strided sampling: sample 128-LANE BLOCKS at
+                # the tensor's sampling rate instead of single elements at
+                # the reference's element stride (compression.py:113-118).
+                # Element-strided extraction fights the [8, 128] tiling no
+                # matter how it is phrased — positional gather 1.5 ms,
+                # strided dynamic_slice 1.8 ms, one-hot einsum ~3 ms per
+                # big ResNet-50 bucket on v5e (the [n, stride] reshape is a
+                # physical relayout) — while whole-lane blocks at a block
+                # stride read contiguous 512 B bursts: measured ~0.1 ms.
+                # Per tensor this is still a systematic sample of the same
+                # fraction of |grad| with a fresh uniform random phase per
+                # step; within-block correlation slightly widens the
+                # threshold estimator's variance, which the bounded ladder
+                # adaptation (compression.py:128-149) exists to correct.
+                # The contract requires sampling to match in distribution,
+                # not positions (SURVEY.md §4); rows run one shared phase
+                # per stride run so the extraction is ONE slice. Stride-1
+                # runs (sample-everything rows) stay exact.
+                L = 128
+                parts = []
+                for gi, (r0, r1, stride, n) in enumerate(b.stride_groups):
+                    kg = jax.random.fold_in(k, gi)
+                    u = jax.random.uniform(kg, ())
+                    Rg = r1 - r0
+                    nb = n // L
+                    if stride == 1:
+                        # the reference's exact sample-everything path
+                        smp = imp_rows[r0:r1, :n]
+                    elif nb == 0:
+                        # sample sets smaller than a lane block (tiny
+                        # tensors only): keep the reference's element
+                        # stride with a fresh random phase — the gather is
+                        # n < 128 elements, off the sizing path
+                        phase = jnp.floor(u * stride).astype(jnp.int32)
+                        pos = phase + jnp.arange(n, dtype=jnp.int32) * stride
+                        pos = jnp.minimum(pos, b.cols - 1)
+                        smp = jnp.take_along_axis(
+                            imp_rows[r0:r1],
+                            jnp.broadcast_to(pos[None, :], (Rg, n)), axis=1)
+                    else:
+                        # nb blocks at block-stride sb spread over the data
+                        # span n*stride (~ the largest row's numel)
+                        sb = max(1, (n * stride) // (nb * L))
+                        phase = jnp.floor(u * sb).astype(jnp.int32)
+                        v = imp_rows[r0:r1, :nb * sb * L].reshape(
+                            Rg, nb, sb, L)
+                        smp = jax.lax.dynamic_slice(
+                            v, (jnp.int32(0), jnp.int32(0), phase,
+                                jnp.int32(0)),
+                            (Rg, nb, 1, L)).reshape(Rg, nb * L)
+                    if smp.shape[1] < b.max_s:
+                        smp = jnp.concatenate(
+                            [smp, jnp.full((Rg, b.max_s - smp.shape[1]),
+                                           neg1)], axis=1)
+                    parts.append(smp)
+                samples = (jnp.concatenate(parts) if len(parts) > 1
+                           else parts[0])
+                # no per-slot validity mask: lane-block slots do not map to
+                # the reference's slot order; out-of-row positions already
+                # read the -1 importance pad and sort below every threshold
             else:
+                s_idx = jnp.arange(b.max_s, dtype=jnp.int32)[None, :]
+                s_valid = s_idx < jnp.asarray(b.num_samples)[:, None]
                 u = jax.random.uniform(k, (R, b.max_s))
                 pos = jnp.floor(u * numels).astype(jnp.int32)
                 # rows sampling everything must sample exactly, not with
@@ -495,17 +697,18 @@ class FlatDGCEngine:
                 # dgc.py sparsify)
                 exact = jnp.asarray(b.num_samples)[:, None] >= numels
                 pos = jnp.where(exact, jnp.minimum(s_idx, numels - 1), pos)
-            # positions are < numel <= cols by the sampling geometry
-            # (reference compression.py:66-85), so the row-local gather
-            # stays in bounds; invalid sample slots read -1
-            samples = jnp.where(
-                s_valid,
-                jnp.take_along_axis(imp_rows, jnp.minimum(pos, b.cols - 1),
-                                    axis=1),
-                jnp.full((), -1.0, vec_c.dtype))             # [R, maxS]
+                # positions are < numel <= cols by the sampling geometry
+                # (reference compression.py:66-85), so the row-local gather
+                # stays in bounds; invalid sample slots read -1
+                samples = jnp.where(
+                    s_valid,
+                    jnp.take_along_axis(imp_rows,
+                                        jnp.minimum(pos, b.cols - 1),
+                                        axis=1),
+                    neg1)                                     # [R, maxS]
 
             # --- per-row sampled threshold (compression.py:123) ---
-            sorted_s = jax.lax.top_k(samples, b.max_k)[0]
+            sorted_s = _exact_topk(samples, b.max_k)[0]
             thr = jnp.take_along_axis(
                 sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
                 axis=1)[:, 0]
@@ -527,11 +730,15 @@ class FlatDGCEngine:
                         self.c.max_adaptation_iters, self.c.resample)
 
             # --- fixed-size selection (ops.select_by_threshold semantics) ---
-            scores = jnp.where(imp_rows >= thr[:, None], imp_rows,
-                               -jnp.ones_like(imp_rows))
-            top_scores, cols = self._select_topk(scores, b.max_sel)
+            # top-k over RAW importance, below-threshold slots invalidated
+            # after the fact: the selected set above thr is identical to
+            # top-k over threshold-masked scores (top-k orders by value, so
+            # the >= thr prefix matches), and skipping the mask saves a
+            # full [R, cols] materialization per bucket; row-tail pads
+            # carry importance -1 < 0 <= thr and can never turn valid
+            top_scores, cols = self._select_topk(imp_rows, b.max_sel)
             slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
-            valid = (top_scores >= 0) & (
+            valid = (top_scores >= thr[:, None]) & (
                 slot < jnp.asarray(b.num_selects)[:, None])
             gidx = jnp.where(valid, row_off + cols.astype(jnp.int32), S)
             # values via a row-local gather from the reshape view (no
@@ -588,32 +795,53 @@ class FlatDGCEngine:
                 return avg, mem
             if clip is not None:
                 avg = self._clip_block(avg, self.layout.names, 0)
-            out, md = self._compensate_dense(mem["momentums"], avg)
-            return out, {"momentums": md, "velocities": mem["velocities"]}
+            # materialize any pending transmit mask from a previous
+            # compressed step before the non-accumulating correction (the
+            # reference zeroed those coords at the compressed step,
+            # memory.py:72-77), and reset it — carrying it forward would
+            # wrongly zero the dense momentum written below
+            mc, vc = mem["momentums_c"], mem["velocities_c"]
+            keep = mem.get("keep_c")
+            if m is not None and T and keep is not None:
+                vc = vc * keep
+                if m.momentum_masking:
+                    mc = mc * keep
+            out_c, mc2 = self._compensate_dense(mc, avg[:T])
+            out_d, md2 = self._compensate_dense(mem["momentums_d"], avg[T:])
+            out = (jnp.concatenate([out_c, out_d]) if T and P > T
+                   else (out_c if T else out_d))
+            return out, {"momentums_c": mc2, "momentums_d": md2,
+                         "velocities_c": vc,
+                         "velocities_d": mem["velocities_d"],
+                         "keep_c": jnp.ones((T,), self.layout.dtype)}
 
         gc, gd = flat_grad[:T], flat_grad[T:]
         if m is not None:
-            mmt, vec = mem["momentums"], mem["velocities"]
-            mc, vc, md = mmt[:T], vec[:T], mmt[T:]
+            mc, vc = mem["momentums_c"], mem["velocities_c"]
+            md = mem["momentums_d"]
         else:
             mc = vc = md = None
 
-        # --- compressed block: compensate -> sparsify -> mask -> gather ---
+        # --- compressed block: masked compensate -> sparsify -> gather ---
         if m is not None:
             if clip is not None:
                 # clipping runs on the LOCAL gradient inside the accumulating
                 # compensate (reference memory.py:52-53)
                 gc = self._clip_block(gc, self.layout.compressed_names, 0)
-            comp, mc, vc = self._compensate_acc(mc, vc, gc)
+            # deferred masking (memory.py:72-77): the PREVIOUS step's
+            # transmit mask is applied on read inside the compensate pass.
+            # x*0 == set-to-0 for finite values, and the sentinel slot is a
+            # structural zero, so padded payload slots are no-ops.
+            comp, mc, vc = self._compensate_acc(mc, vc, gc, mem["keep_c"])
         else:
             comp = gc
         values, indices = self.sparsify(comp, key)
         if m is not None:
-            # the sentinel is a structural-zero slot, so zeroing it is a
-            # no-op — no drop mode / bounds games needed
-            vc = vc.at[indices].set(0.0)
-            if m.momentum_masking:
-                mc = mc.at[indices].set(0.0)
+            # record THIS step's transmit mask for the next compensate —
+            # a scatter into a fresh f32 ones buffer (the fast path);
+            # scatter-set into the live mmt/vec buffers measured 1.8 ms
+            # on v5e, and sub-word masks scatter via a serial while-loop
+            new_keep = jnp.ones((T,), vc.dtype).at[indices].set(0.0)
 
         wire_values = (values.astype(jnp.float16)
                        if self.c.fp16_values else values)
@@ -639,24 +867,44 @@ class FlatDGCEngine:
             out = out_c
 
         if m is not None:
-            mem = {"momentums": jnp.concatenate([mc, md]) if P > T else mc,
-                   "velocities": jnp.concatenate([vc, vec[T:]])
-                   if P > T else vc}
+            mem = {"momentums_c": mc, "velocities_c": vc,
+                   "momentums_d": md, "velocities_d": mem["velocities_d"],
+                   "keep_c": new_keep}
         return out, mem
 
     # -------------------------------------------------------------- #
     # checkpoint-format parity (reference memory.py:79-88)           #
     # -------------------------------------------------------------- #
 
+    def memory_full(self, mem: Dict) -> Dict:
+        """Split memory -> canonical {momentums: [P], velocities: [P]}
+        view, with any pending (deferred) transmit mask materialized —
+        checkpoint/inspection time only, the hot path never builds it.
+        The keep vector is ratio-independent ([T] never changes), so a
+        pending mask survives warm-up engine rebuilds untouched — the next
+        compensate applies it identically."""
+        mc, vc = mem["momentums_c"], mem["velocities_c"]
+        m = self._mem
+        if m is not None and mc.shape[0] > 0:
+            keep = mem["keep_c"].astype(vc.dtype)
+            vc = vc * keep
+            if m.momentum_masking:
+                mc = mc * keep
+        return {
+            "momentums": jnp.concatenate([mc, mem["momentums_d"]]),
+            "velocities": jnp.concatenate([vc, mem["velocities_d"]]),
+        }
+
     def memory_state_dict(self, mem: Dict) -> Optional[Dict]:
         """Flat memory -> per-name {momentums, velocities} (the reference's
         checkpoint format, memory.py:79-80)."""
         if not mem:
             return None
+        full = self.memory_full(mem)
         return {
-            "momentums": self.layout.unflatten_named(mem["momentums"],
+            "momentums": self.layout.unflatten_named(full["momentums"],
                                                      keep_1d=True),
-            "velocities": self.layout.unflatten_named(mem["velocities"],
+            "velocities": self.layout.unflatten_named(full["velocities"],
                                                       keep_1d=True),
         }
 
@@ -666,15 +914,20 @@ class FlatDGCEngine:
         if not mem or saved is None:
             return mem
         lay = self.layout
+        T = self.T
+        full = self.memory_full(mem)
         out = {}
         for key in ("momentums", "velocities"):
-            flat = mem[key]
+            flat = full[key]
             for n in lay.names:
                 if n in saved[key]:
                     piece = jnp.asarray(saved[key][n]).reshape(-1)
                     flat = jax.lax.dynamic_update_slice(
                         flat, piece.astype(flat.dtype), (lay.offsets[n],))
-            out[key] = flat
+            out[key + "_c"] = flat[:T]
+            out[key + "_d"] = flat[T:]
+        # loaded buffers are canonical (already masked): nothing pending
+        out["keep_c"] = jnp.ones((T,), self.layout.dtype)
         return out
 
 

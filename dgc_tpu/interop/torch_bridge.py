@@ -109,7 +109,9 @@ class TorchDGCBridge:
         try:
             return self._torch.from_dlpack(a)
         except Exception:
-            return self._torch.from_numpy(np.asarray(a))
+            # np.array (not asarray): jax buffers are read-only through
+            # numpy, and torch.from_numpy on a non-writable array is UB
+            return self._torch.from_numpy(np.array(a))
 
     def exchange(self, named_grads: Dict) -> Dict:
         """Run compress -> exchange -> decompress on the device mesh.

@@ -35,6 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_compensate", "fused_compensate_reference",
+           "fused_compensate_masked", "fused_compensate_masked_reference",
            "ladder_counts", "ladder_counts_reference",
            "topk_rows", "topk_rows_reference", "use_pallas"]
 
@@ -114,6 +115,81 @@ def fused_compensate(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
         out_specs=(spec, spec),
         interpret=_interpret(),
     )(g2, m2, v2)
+    om, ov = om.reshape(-1), ov.reshape(-1)
+    return (om[:n], ov[:n]) if pad else (om, ov)
+
+
+def fused_compensate_masked_reference(grad, mmt, vec, keep, momentum: float,
+                                      nesterov: bool, momentum_masking: bool):
+    """jnp reference: apply the previous step's transmit mask on READ, then
+    compensate. Bitwise identical to masking eagerly after the previous
+    sparsify (multiply is deterministic), but the mask multiply rides the
+    compensate pass instead of costing its own full-buffer write+read
+    (reference order: memory.update zeros transmitted coords, memory.py:
+    72-77; the next compensate reads them, memory.py:50-63)."""
+    kf = keep.astype(vec.dtype)
+    m_in = mmt * kf if momentum_masking else mmt
+    return fused_compensate_reference(grad, m_in, vec * kf, momentum,
+                                      nesterov)
+
+
+def _compensate_masked_kernel(g_ref, m_ref, v_ref, k_ref, om_ref, ov_ref, *,
+                              momentum, nesterov, momentum_masking):
+    g = g_ref[:]
+    # keep is 0/1 in the grad dtype already (f32 engine mask — sub-word
+    # masks are NOT used: their scatter lowers to a serial while-loop on
+    # v5e, see FlatDGCEngine.init_memory); astype is a no-op safety net
+    keep = k_ref[:].astype(g.dtype)
+    m0 = m_ref[:] * keep if momentum_masking else m_ref[:]
+    v0 = v_ref[:] * keep
+    if nesterov:
+        m = (m0 + g) * momentum
+        ov_ref[:] = v0 + m + g
+    else:
+        m = momentum * m0 + g
+        ov_ref[:] = v0 + m
+    om_ref[:] = m
+
+
+@functools.partial(jax.jit, static_argnames=("momentum", "nesterov",
+                                             "momentum_masking"))
+def fused_compensate_masked(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
+                            keep: jax.Array, momentum: float,
+                            nesterov: bool = False,
+                            momentum_masking: bool = True
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Single-pass mask-on-read + compensate over flat buffers: reads
+    (grad, mmt, vec, keep 0/1), writes (mmt', vec') — one extra input
+    stream vs :func:`fused_compensate` instead of a separate masked-buffer
+    materialization (measured 0.83 ms/step of full-[T] traffic at
+    ResNet-50 scale on v5e). ``keep`` is any multiplicative-identity dtype
+    (the engine uses f32: sub-word scatters lower to a serial while-loop
+    on v5e)."""
+    n = grad.shape[0]
+    pad = (-n) % (_SUBLANE * _LANE)
+    if pad:
+        grad, mmt, vec = (jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+                          for x in (grad, mmt, vec))
+        keep = jnp.concatenate([keep, jnp.ones((pad,), keep.dtype)])
+    rows = (n + pad) // _LANE
+    shape2d = (rows, _LANE)
+    g2, m2, v2, k2 = (x.reshape(shape2d) for x in (grad, mmt, vec, keep))
+
+    block_rows = min(_CHUNK_ROWS, rows)
+    grid = pl.cdiv(rows, block_rows)
+    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    om, ov = pl.pallas_call(
+        functools.partial(_compensate_masked_kernel, momentum=momentum,
+                          nesterov=nesterov,
+                          momentum_masking=momentum_masking),
+        grid=(grid,),
+        out_shape=(jax.ShapeDtypeStruct(shape2d, grad.dtype),
+                   jax.ShapeDtypeStruct(shape2d, grad.dtype)),
+        in_specs=[spec, spec, spec, spec],
+        out_specs=(spec, spec),
+        interpret=_interpret(),
+    )(g2, m2, v2, k2)
     om, ov = om.reshape(-1), ov.reshape(-1)
     return (om[:n], ov[:n]) if pad else (om, ov)
 

@@ -1,0 +1,271 @@
+"""Dev micro-bench: per-stage isolation of the flat DGC engine at
+ResNet-50 / ratio 0.001 shapes on the real TPU chip.
+
+Same scan-K + one-scalar-readback methodology as bench.py (the relay's
+block_until_ready lies; per-call dispatch drifts). Each stage runs K times
+inside one jitted lax.scan with a data dependency threaded through, then
+one forced readback; the relay RTT is subtracted and the remainder
+amortized.
+
+Usage: python scripts/bench_stages.py [--model resnet50|resnet20] [--k 30]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+_ssum = jax.jit(lambda x: jnp.sum(x))
+
+
+def measure_rtt(samples=8):
+    x = jax.device_put(jnp.ones((8,), jnp.float32))
+    float(_ssum(x))
+    best = None
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        float(_ssum(x))
+        dt = (time.perf_counter() - t0) * 1e3
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def time_scan(fn, carry0, k, rtt, repeats=5, name=""):
+    """fn: carry -> carry (same pytree structure). Returns ms/iter."""
+    @jax.jit
+    def loop(c):
+        def body(c, _):
+            return fn(c), 0
+        c, _ = jax.lax.scan(body, c, None, length=k)
+        return c
+
+    c = loop(carry0)  # compile + warm
+    float(_ssum(jax.tree.leaves(c)[0]))
+    best = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        c = loop(c)
+        float(_ssum(jax.tree.leaves(c)[0]))
+        dt = ((time.perf_counter() - t0) * 1e3 - rtt) / k
+        best = dt if best is None else min(best, dt)
+    print(f"{name:<44s}: {best:8.4f} ms", file=sys.stderr)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--k", type=int, default=30)
+    ap.add_argument("--ratio", type=float, default=0.001)
+    args = ap.parse_args()
+
+    from dgc_tpu import DGCCompressor, DGCSGDMemory
+    from dgc_tpu.compression.flat import FlatDGCEngine, ParamLayout
+    from dgc_tpu.models import resnet20, resnet50
+    from dgc_tpu.utils.pytree import named_flatten
+
+    model = resnet50() if args.model == "resnet50" else resnet20()
+    shape = (1, 224, 224, 3) if args.model == "resnet50" else (1, 32, 32, 3)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros(shape), train=True)
+    named, _ = named_flatten(v["params"])
+
+    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    layout = ParamLayout.for_compressor(v["params"], comp)
+    engine = FlatDGCEngine(comp, layout)
+
+    print(f"model={args.model} ratio={args.ratio} "
+          f"P={layout.total} T={layout.t_compressed} "
+          f"payload={engine.payload_size}", file=sys.stderr)
+    for b in engine.buckets:
+        sel = "approx" if (comp.approx_recall is not None
+                           and b.max_sel > 128) else "exact"
+        print(f"  bucket R={b.rows:3d} cols={b.cols:9d} "
+              f"max_s={b.max_s:8d} max_k={b.max_k:6d} "
+              f"max_sel={b.max_sel:6d} exact={b.exact} sel={sel} "
+              f"payload={b.payload}", file=sys.stderr)
+
+    rtt = measure_rtt()
+    print(f"RTT {rtt:.1f} ms", file=sys.stderr)
+
+    rng = np.random.RandomState(0)
+    T = layout.t_compressed
+    P = layout.total
+    g = jax.device_put(jnp.asarray(rng.randn(P), jnp.float32) * 1e-2)
+    mem = engine.init_memory()
+    key = jax.random.PRNGKey(1)
+
+    # --- full pipeline single-device (no collectives; psum/all_gather on
+    #     1 device are local copies) ---
+    from jax.sharding import Mesh, PartitionSpec as Pspec
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    def full(c):
+        grad, m = c
+        def worker(fg, mm):
+            out, mm = engine.exchange(fg, mm, key, "data", 1)
+            return out, mm
+        out, m = jax.shard_map(
+            worker, mesh=mesh, in_specs=(Pspec(), Pspec()),
+            out_specs=(Pspec(), Pspec()), check_vma=False)(grad, m)
+        return (out * 0.999, m)
+
+    time_scan(full, (g, mem), args.k, rtt, name="FULL exchange (1-dev)")
+
+    # --- stage: fused compensate over [T] ---
+    gc = g[:T]
+    mc, vc = mem["momentums_c"], mem["velocities_c"]
+
+    def comp_stage(c):
+        gg, m, vv = c
+        out, m2, v2 = engine._compensate_acc(m, vv, gg)
+        return (gg * 0.999, m2, v2 * 0.5)
+
+    time_scan(comp_stage, (gc, mc, vc), args.k, rtt, name="compensate [T]")
+
+    # --- stage: sparsify (all buckets) ---
+    def spars(c):
+        vec, acc = c
+        vals, idx = engine.sparsify(vec, key)
+        return (vec * 0.999, acc + jnp.sum(vals) + jnp.sum(idx))
+
+    time_scan(spars, (gc, jnp.float32(0)), args.k, rtt,
+              name="sparsify ALL buckets")
+
+    # --- per-bucket sparsify ---
+    saved = engine.buckets
+    for bi in range(len(saved)):
+        engine.buckets = [saved[bi]]
+        time_scan(spars, (gc, jnp.float32(0)), args.k, rtt,
+                  name=f"sparsify bucket {bi} (R={saved[bi].rows}, "
+                       f"cols={saved[bi].cols})")
+    engine.buckets = saved
+
+    # --- inside-bucket breakdown for the big (adaptive) buckets ---
+    for bi, b in enumerate(saved):
+        if b.exact or b.rows * b.cols * 4 < 16 * 1024 * 1024:
+            continue
+        R, cols = b.rows, b.cols
+        block0 = gc[b.base:b.base + R * cols].reshape(R, cols)
+        numels = jnp.asarray(b.numels)[:, None]
+        col = jnp.arange(cols, dtype=jnp.int32)[None, :]
+        imp0 = jnp.where(col < numels, jnp.abs(block0), -1.0)
+
+        def imp_stage(c):
+            blk, acc = c
+            imp = jnp.where(col < numels, jnp.abs(blk), -1.0)
+            return (blk * 0.999, acc + imp[0, 0])
+
+        time_scan(imp_stage, (block0, jnp.float32(0)), args.k, rtt,
+                  name=f"  b{bi} importance mask [R,cols]")
+
+        strides = jnp.asarray(b.strides)[:, None]
+        s_idx = jnp.arange(b.max_s, dtype=jnp.int32)[None, :]
+        s_valid = s_idx < jnp.asarray(b.num_samples)[:, None]
+
+        def sample_stage(c):
+            imp, acc = c
+            u = jax.random.uniform(key, (R, 1))
+            phase = jnp.floor(u * strides).astype(jnp.int32)
+            pos = phase + s_idx * strides
+            samples = jnp.where(
+                s_valid,
+                jnp.take_along_axis(imp, jnp.minimum(pos, cols - 1), axis=1),
+                -1.0)
+            return (imp * 0.999, acc + jnp.sum(samples[:, :2]))
+
+        time_scan(sample_stage, (imp0, jnp.float32(0)), args.k, rtt,
+                  name=f"  b{bi} strided sample gather [R,{b.max_s}]")
+
+        u = jax.random.uniform(key, (R, 1))
+        phase = jnp.floor(u * strides).astype(jnp.int32)
+        pos = phase + s_idx * strides
+        samples0 = jnp.where(
+            s_valid,
+            jnp.take_along_axis(imp0, jnp.minimum(pos, cols - 1), axis=1),
+            -1.0)
+
+        def thr_stage(c):
+            smp, acc = c
+            sorted_s = jax.lax.top_k(smp, b.max_k)[0]
+            thr = jnp.take_along_axis(
+                sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
+                axis=1)[:, 0]
+            return (smp * 0.999, acc + jnp.sum(thr))
+
+        time_scan(thr_stage, (samples0, jnp.float32(0)), args.k, rtt,
+                  name=f"  b{bi} threshold top_k over samples")
+
+        from dgc_tpu.ops import kernels as kk
+        thr0 = jnp.abs(jnp.asarray(rng.randn(R), jnp.float32)) * 1e-2
+
+        def ladder_stage(c):
+            imp, acc = c
+            counts = kk.ladder_counts(imp, thr0, comp.compress_lower_bound,
+                                      comp.max_adaptation_iters + 1)
+            return (imp * 0.999, acc + jnp.sum(counts))
+
+        time_scan(ladder_stage, (imp0, jnp.float32(0)), args.k, rtt,
+                  name=f"  b{bi} ladder counts kernel")
+
+        def select_stage(c):
+            imp, acc = c
+            scores = jnp.where(imp >= thr0[:, None], imp,
+                               -jnp.ones_like(imp))
+            tv, ti = jax.lax.approx_max_k(scores, b.max_sel,
+                                          recall_target=0.95)
+            return (imp * 0.999, acc + jnp.sum(tv) + jnp.sum(ti))
+
+        time_scan(select_stage, (imp0, jnp.float32(0)), args.k, rtt,
+                  name=f"  b{bi} mask+approx_max_k k={b.max_sel}")
+
+        def select_nomask(c):
+            imp, acc = c
+            tv, ti = jax.lax.approx_max_k(imp, b.max_sel,
+                                          recall_target=0.95)
+            return (imp * 0.999, acc + jnp.sum(tv) + jnp.sum(ti))
+
+        time_scan(select_nomask, (imp0, jnp.float32(0)), args.k, rtt,
+                  name=f"  b{bi} approx_max_k alone k={b.max_sel}")
+
+        tv0, ti0 = jax.jit(lambda s: jax.lax.approx_max_k(
+            s, b.max_sel, recall_target=0.95))(imp0)
+
+        def gather_vals(c):
+            blk, acc = c
+            vals = jnp.take_along_axis(blk, ti0, axis=1)
+            return (blk * 0.999, acc + jnp.sum(vals))
+
+        time_scan(gather_vals, (block0, jnp.float32(0)), args.k, rtt,
+                  name=f"  b{bi} value gather [R,{b.max_sel}]")
+
+    # --- masking + scatter-add decompress ---
+    vals0, idx0 = jax.jit(lambda v, k: engine.sparsify(v, k))(gc, key)
+
+    def mask_stage(c):
+        vv, mm = c
+        vv = vv.at[idx0].set(0.0)
+        mm = mm.at[idx0].set(0.0)
+        return (vv * 0.999, mm * 0.999)
+
+    time_scan(mask_stage, (vc, mc), args.k, rtt, name="masking 2x scatter")
+
+    def scatter_stage(c):
+        acc = jnp.zeros((T,), jnp.float32)
+        acc = acc.at[idx0].add(vals0 + c[0])
+        return (acc[:1] * 0.999,)
+
+    time_scan(scatter_stage, (jnp.zeros((1,)),), args.k, rtt,
+              name="scatter-add decompress")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,7 +75,7 @@ def test_resnet50_exchange_one_step():
     nz = np.count_nonzero(out[:layout.t_data])
     assert 0 < nz <= 2 * engine.payload_size
     # residual accumulated for untransmitted coords
-    assert np.abs(np.asarray(mem["velocities"])[:layout.t_data]).sum() > 0
+    assert np.abs(np.asarray(mem["velocities_c"])[:layout.t_data]).sum() > 0
 
 
 def test_approx_recall_knob():

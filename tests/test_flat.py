@@ -79,6 +79,19 @@ def test_layout_roundtrip():
         np.testing.assert_array_equal(np.asarray(p), np.asarray(named[n]))
 
 
+def test_layout_rejects_int32_index_overflow():
+    """A flat buffer at/above 2**31 slots would overflow the int32 wire
+    indices (the always-on int32_indices path) — the layout must refuse it
+    up front and point at the int64 wire format (BASELINE 'int64 idx' row).
+    Shape-only structs keep the test allocation-free."""
+    huge = {"w": jax.ShapeDtypeStruct((2 ** 31 + 128,), jnp.float32)}
+    with pytest.raises(ValueError, match="int32"):
+        ParamLayout(huge, ["w"])
+    # just under the ceiling (after alignment) still builds
+    ok = {"w": jax.ShapeDtypeStruct((2 ** 20,), jnp.float32)}
+    assert ParamLayout(ok, ["w"]).total < 2 ** 31
+
+
 def test_layout_mask_vector():
     params = _params()
     layout = ParamLayout(params, [])
@@ -87,6 +100,15 @@ def test_layout_mask_vector():
     assert mask.sum() == sum(p.size for n, p in named.items() if "bn" not in n)
     off, sz = layout.offsets["bn/scale"], layout.sizes["bn/scale"]
     assert (mask[off:off + sz] == 0).all()
+
+
+def _mem_full(engine, mem, w=None):
+    """Split flat memory -> canonical {momentums, velocities} [P] numpy
+    view via the engine (materializes any pending deferred mask),
+    optionally selecting worker w from a [W]-leading-axis tree."""
+    if w is not None:
+        mem = jax.tree.map(lambda x: x[w], mem)
+    return {k: np.asarray(v) for k, v in engine.memory_full(mem).items()}
 
 
 def _flat_exchange_fn(dist, engine, mesh):
@@ -171,9 +193,11 @@ def test_flat_matches_per_tensor_exchange(mesh8, nesterov, momentum_masking):
                 np.asarray(named_out_p[n][0]).reshape(-1),
                 rtol=1e-5, atol=1e-6,
                 err_msg=f"exchanged grads step {step} {n}")
-        # memory equivalence (flat stores [P] buffers; compare per name)
+        # memory equivalence (flat stores split buffers; compare per name
+        # through the full view)
+        full_f = _mem_full(engine, mem_f, w=0)
         for mkey in ("momentums", "velocities"):
-            named_m_f = layout.unflatten_named(mem_f[mkey][0], keep_1d=True)
+            named_m_f = layout.unflatten_named(full_f[mkey], keep_1d=True)
             for n in layout.names:
                 np.testing.assert_allclose(
                     np.asarray(named_m_f[n]),
@@ -374,19 +398,22 @@ def test_flat_ratio_one_routes_dense(mesh8):
     # == 0.9*0 + mean(g)
     np.testing.assert_allclose(np.asarray(out[0]), g.mean(0), rtol=1e-5)
     # velocities untouched on the dense path (memory.py:64-70)
-    np.testing.assert_array_equal(np.asarray(mem2["velocities"][0]), 0)
+    np.testing.assert_array_equal(
+        _mem_full(engine, mem2, w=0)["velocities"], 0)
 
 
 def test_flat_memory_state_dict_roundtrip():
     params, comp, dist = _make_dist(sample_ratio=1.0, ratio=0.05)
     layout, engine = dist.make_flat(params)
     mem = engine.init_memory()
-    mem = {"momentums": mem["momentums"] + 1.0,
-           "velocities": mem["velocities"] + 2.0}
+    mem = {k: v if k == "keep_c"
+           else v + (1.0 if k.startswith("momentums") else 2.0)
+           for k, v in mem.items()}
     sd = engine.memory_state_dict(mem)
     assert set(sd) == {"momentums", "velocities"}
     assert set(sd["momentums"]) == set(layout.names)
-    back = engine.load_memory_state_dict(engine.init_memory(), sd)
+    back = _mem_full(
+        engine, engine.load_memory_state_dict(engine.init_memory(), sd))
     # per-name contents round-trip; gap slots stay structurally zero
     for mkey, val in (("momentums", 1.0), ("velocities", 2.0)):
         named_b = layout.unflatten_named(back[mkey], keep_1d=True)
@@ -468,8 +495,9 @@ def test_flat_gradient_clipping_matches_per_tensor(mesh8, global_clip):
                 np.asarray(named_out_p[n][0]).reshape(-1),
                 rtol=1e-5, atol=1e-6,
                 err_msg=f"exchanged grads step {step} {n}")
+        full_f = _mem_full(engine, mem_f, w=0)
         for mkey in ("momentums", "velocities"):
-            named_m_f = layout.unflatten_named(mem_f[mkey][0], keep_1d=True)
+            named_m_f = layout.unflatten_named(full_f[mkey], keep_1d=True)
             for n in layout.names:
                 np.testing.assert_allclose(
                     np.asarray(named_m_f[n]),
@@ -478,7 +506,7 @@ def test_flat_gradient_clipping_matches_per_tensor(mesh8, global_clip):
                     err_msg=f"{mkey} step {step} {n}")
         # the clip must actually engage: raw grads have norm >> 0.05
         for n in layout.compressed_names:
-            seg = np.asarray(mem_f["momentums"][0])[
+            seg = full_f["momentums"][
                 layout.offsets[n]:layout.offsets[n] + layout.sizes[n]]
             if np.linalg.norm(seg) < 1.0:
                 clipped_any = True

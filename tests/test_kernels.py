@@ -28,6 +28,36 @@ def test_fused_compensate_matches_reference(n, nesterov):
                                rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.parametrize("momentum_masking", [False, True])
+@pytest.mark.parametrize("nesterov", [False, True])
+@pytest.mark.parametrize("n", [127, 1024, 65536 + 3])
+def test_fused_compensate_masked_matches_reference(n, nesterov,
+                                                   momentum_masking):
+    """The mask-on-read kernel body must run (interpret mode) and match its
+    reference across all nesterov/momentum_masking combinations, and the
+    combined op must equal eager mask-then-compensate."""
+    rng = np.random.RandomState(n + 7)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n), jnp.float32)
+    v = jnp.asarray(rng.randn(n), jnp.float32)
+    keep = jnp.asarray(rng.rand(n) > 0.3, jnp.float32)
+    om, ov = kernels.fused_compensate_masked(g, m, v, keep, 0.9, nesterov,
+                                             momentum_masking)
+    rm, rv = kernels.fused_compensate_masked_reference(
+        g, m, v, keep, 0.9, nesterov, momentum_masking)
+    np.testing.assert_allclose(np.asarray(om), np.asarray(rm),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ov), np.asarray(rv),
+                               rtol=1e-6, atol=1e-6)
+    # deferred == eager: masking the buffers first then compensating
+    em, ev = kernels.fused_compensate_reference(
+        g, m * keep if momentum_masking else m, v * keep, 0.9, nesterov)
+    np.testing.assert_allclose(np.asarray(om), np.asarray(em),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ov), np.asarray(ev),
+                               rtol=1e-6, atol=1e-6)
+
+
 @pytest.mark.parametrize("shape", [(1, 64), (3, 128), (5, 1000), (16, 4096)])
 def test_ladder_counts_matches_reference(shape):
     rng = np.random.RandomState(shape[1])
