@@ -90,12 +90,15 @@ class ParamLayout:
     """
 
     #: bucket-count/padding exchange rate for _group_by_size's partition
-    #: DP: one extra bucket costs fixed per-step op floors (sample slice,
-    #: threshold top-k, selection — measured ~0.2-0.4 ms each on v5e),
-    #: while one padded slot costs ~0.1 ns/step of extra bandwidth across
-    #: the full-pass stages plus 4-5 buffers of storage. 2M slots/bucket is
-    #: the measured break-even within a factor of ~2 either way
-    FLOOR_SLOTS = 2_000_000
+    #: DP. Padded slots are NOT just storage: they inflate the operand
+    #: AREA of every per-bucket pass (importance, ladder, selection
+    #: top-k), whose cost scales with rows x cols — measured at ResNet-20,
+    #: one 22x36864 merged bucket (3x area) cost 0.25 ms/step MORE than
+    #: two tight buckets. A bucket's fixed floor (extra op launches) is
+    #: worth ~300k slots of padding on v5e at both measured scales
+    #: (ResNet-20: 0.39 -> 0.14 ms overhead vs the 2M setting;
+    #: ResNet-50: neutral within noise).
+    FLOOR_SLOTS = 300_000
 
     def __init__(self, tree, compressed_names: Sequence[str] = ()):
         named, self.treedef = named_flatten(tree)
@@ -549,7 +552,7 @@ class FlatDGCEngine:
         CPU approx_max_k lowers to an exact sort, so the flat-vs-per-tensor
         equivalence tests see identical selections."""
         r = self.c.approx_recall
-        if r is not None and (max_sel > 128 or scores.shape[1] >= 32768):
+        if r is not None and max_sel > 128:
             if kernels.use_pallas():
                 # TPU: aggregate_to_topk=False + a manual lax.top_k over
                 # the [R, l] candidate set — same candidates, same recall,

@@ -10,6 +10,7 @@ from dgc_tpu.training.step import (
     build_train_step,
     make_flat_setup,
     make_flat_state,
+    make_loss_fn,
 )
 from dgc_tpu.training.lr import (
     cosine_schedule,
@@ -19,7 +20,7 @@ from dgc_tpu.training.lr import (
 
 __all__ = [
     "TrainState", "shard_state", "state_specs", "with_leading_axis",
-    "build_eval_step", "build_train_step",
+    "build_eval_step", "build_train_step", "make_loss_fn",
     "FlatSetup", "make_flat_setup", "make_flat_state",
     "cosine_schedule", "make_lr_schedule", "multistep_schedule",
 ]

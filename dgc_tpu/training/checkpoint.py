@@ -45,13 +45,35 @@ class CheckpointManager:
 
     def save(self, epoch: int, state: Any, meters: Dict[str, float],
              best: bool = False) -> str:
-        """Save epoch checkpoint, update latest pointer, rotate, track best."""
+        """Save epoch checkpoint, update latest pointer, rotate, track best.
+
+        Multi-process (``jax.process_count() > 1``): EVERY process must
+        call this with the same global (sharded) state — orbax coordinates
+        the distributed array write itself (the directory must be a shared
+        filesystem, as on TPU pods) — while all the filesystem bookkeeping
+        (meters/latest files, best copy, rotation) happens on the
+        coordinator only, fenced by barriers so no process races a
+        directory that is being rotated. Single-process keeps the simple
+        host-materialized write."""
+        multi = jax.process_count() > 1
+        coord = jax.process_index() == 0
         path = self._epoch_dir(epoch)
-        host_state = jax.tree.map(np.asarray, jax.device_get(state))
-        if os.path.exists(path):
-            shutil.rmtree(path)
-        self._ckptr.save(path, host_state)
-        self._ckptr.wait_until_finished()
+        if multi:
+            from jax.experimental import multihost_utils
+            if coord and os.path.exists(path):
+                shutil.rmtree(path)
+            multihost_utils.sync_global_devices(f"ckpt_pre_save_e{epoch}")
+            self._ckptr.save(path, state)      # collective: global arrays
+            self._ckptr.wait_until_finished()
+            multihost_utils.sync_global_devices(f"ckpt_post_save_e{epoch}")
+            if not coord:
+                return path
+        else:
+            host_state = jax.tree.map(np.asarray, jax.device_get(state))
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            self._ckptr.save(path, host_state)
+            self._ckptr.wait_until_finished()
         with open(os.path.join(path, "meters.json"), "w") as f:
             payload = {k: float(v) for k, v in meters.items()}
             payload["epoch"] = epoch
@@ -99,8 +121,17 @@ class CheckpointManager:
             path = self._epoch_dir(epoch)
             if not os.path.exists(path):
                 return None
-        host_template = jax.tree.map(
-            lambda x: np.asarray(jax.device_get(x)), template)
+        if jax.process_count() > 1:
+            # restore straight into the live sharded layout: global arrays
+            # cannot be host-materialized per process, and the sharding on
+            # the abstract template tells orbax how to place each shard
+            host_template = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    np.shape(x), x.dtype,
+                    sharding=getattr(x, "sharding", None)), template)
+        else:
+            host_template = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), template)
         try:
             state = self._ckptr.restore(path, host_template)
             # orbax only validates tree STRUCTURE; stale checkpoints from a

@@ -23,11 +23,20 @@ def printr(*args, **kwargs):
 
 
 class MetricWriter:
+    """Coordinator-only writer: on non-zero processes every method is a
+    no-op (the reference's SummaryWriter lives on rank 0 only,
+    train.py:197-201 — multiple processes appending to one JSONL file would
+    interleave corruptly on a shared filesystem)."""
+
     def __init__(self, logdir: str):
+        import jax
         self.logdir = logdir
+        self._f = None
+        self._tb = None
+        if jax.process_index() != 0:
+            return
         os.makedirs(logdir, exist_ok=True)
         self._f = open(os.path.join(logdir, "metrics.jsonl"), "a")
-        self._tb = None
         try:
             from tensorboardX import SummaryWriter  # optional
             self._tb = SummaryWriter(logdir)
@@ -35,6 +44,8 @@ class MetricWriter:
             pass
 
     def add_scalar(self, tag: str, value: float, step: int):
+        if self._f is None:
+            return
         self._f.write(json.dumps(
             {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
         self._f.flush()
@@ -42,6 +53,7 @@ class MetricWriter:
             self._tb.add_scalar(tag, value, step)
 
     def close(self):
-        self._f.close()
+        if self._f is not None:
+            self._f.close()
         if self._tb is not None:
             self._tb.close()

@@ -1,0 +1,286 @@
+"""Accuracy parity: dense SGD vs DGC at the flagship operating point.
+
+The reference's entire verification story is "DGC matches the dense
+baseline's top-1" (reproduce tables, /root/reference/README.md:117-128).
+This experiment reproduces that comparison end-to-end at the flagship
+ratio 0.001 with the wm5 warm-up on ResNet-20 and the 8-worker topology,
+on a NON-saturating task: class prototypes that live in a low-dimensional
+subspace of pixel space plus isotropic noise, sized so the Bayes-optimal
+top-1 is well below 100% — dense SGD plateaus, and neither arm can
+saturate the task (the round-1 synthetic table's flaw).
+
+Execution design for the relay-attached single v5e chip:
+* batches are GENERATED ON DEVICE inside the epoch scan from the class
+  prototypes (a fresh stream per step: no 600 MB host->device transfer —
+  which wedges the relay — no memorization confound, and eval accuracy is
+  a direct generalization measurement),
+* one epoch = one jitted lax.scan dispatch (the relay's per-call latency
+  never touches the measurement),
+* the 8-worker data-parallel topology runs as ``jax.vmap(axis_name=...)``
+  on the single chip — the engine's ``all_gather``/``psum`` collectives
+  batch over the vmapped worker axis with identical semantics to the
+  8-device mesh (the same engine code the multichip path runs).
+
+Usage:
+  python scripts/accuracy_parity.py --arms dense,dgc --epochs 150
+  python scripts/accuracy_parity.py --arms dgc --ratio 0.001 --drop-recall 0.9
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+AX = "w"  # worker axis name (vmap-simulated data-parallel axis)
+
+
+def make_protos(key, num_classes, subspace_dim, image_size=32,
+                proto_scale=1.0):
+    """Prototype-subspace task parameters.
+
+    Prototypes ``proto_c = z_c @ M`` with z_c in R^d: classes differ only
+    inside a d-dimensional subspace of pixel space; isotropic noise sigma
+    makes nearest-prototype classification imperfect (pairwise Bayes error
+    ~ Q(|z_c - z_c'| / (2 sigma))), so top-1 plateaus strictly below 100%.
+    """
+    kz, km = jax.random.split(key)
+    D = image_size * image_size * 3
+    z = jax.random.normal(kz, (num_classes, subspace_dim))
+    m = jax.random.normal(km, (subspace_dim, D)) / np.sqrt(subspace_dim)
+    return proto_scale * (z @ m).reshape(num_classes, image_size,
+                                         image_size, 3)
+
+
+def sample_batch(protos, key, n, sigma, num_classes, label_noise=0.0):
+    """One fresh batch from the task distribution, on device.
+
+    ``label_noise`` relabels that fraction of samples uniformly at random
+    (train AND eval streams alike): an IRREDUCIBLE error floor, so top-1
+    has a hard ceiling of ``(1-p) + p/C`` and no arm can saturate the
+    task — the non-saturation guarantee the round-1 synthetic table
+    lacked."""
+    kl, kn, kf, kr = jax.random.split(key, 4)
+    labels = jax.random.randint(kl, (n,), 0, num_classes)
+    images = protos[labels] + sigma * jax.random.normal(
+        kn, (n,) + protos.shape[1:])
+    if label_noise > 0:
+        flip = jax.random.uniform(kf, (n,)) < label_noise
+        labels = jnp.where(flip, jax.random.randint(kr, (n,), 0,
+                                                    num_classes), labels)
+    return images, labels
+
+
+def build_arm(arm, variables, lr_sched, world, ratio, warmup_epochs, args):
+    from dgc_tpu import (Compression, DGCCompressor, DGCSGDMemory,
+                         DistributedOptimizer, dgc_sgd, sgd)
+
+    if arm == "dense":
+        dist = DistributedOptimizer(
+            sgd(lr_sched, momentum=0.9, weight_decay=1e-4),
+            Compression.none(), axis_name=AX, world_size=world)
+        comp = dist.compressor
+    else:
+        # arm "dgc" runs the production approx selection; "dgc_exact"
+        # forces exact top-k — the measured accuracy delta between them is
+        # the cost of approx_recall (VERDICT round-1 item 2)
+        recall = None if arm == "dgc_exact" else args.approx_recall
+        comp = DGCCompressor(
+            ratio, memory=DGCSGDMemory(momentum=0.9),
+            warmup_epochs=warmup_epochs,
+            approx_recall=recall)
+        from dgc_tpu.utils.pytree import named_flatten
+        named, _ = named_flatten(variables["params"])
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        dist = DistributedOptimizer(
+            dgc_sgd(lr_sched, momentum=0.9, weight_decay=1e-4), comp,
+            axis_name=AX, world_size=world)
+    return comp, dist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arms", default="dense,dgc")
+    ap.add_argument("--epochs", type=int, default=150)
+    ap.add_argument("--classes", type=int, default=100)
+    ap.add_argument("--subspace", type=int, default=24)
+    ap.add_argument("--sigma", type=float, default=2.0)
+    ap.add_argument("--label-noise", type=float, default=0.0)
+    ap.add_argument("--proto-scale", type=float, default=1.0,
+                    help="scales class separation: the discriminant SNR is "
+                         "~|dz|*rownorm*scale/(2*sigma); shrink to push the "
+                         "Bayes ceiling below 100%%")
+    ap.add_argument("--train-size", type=int, default=50176)
+    ap.add_argument("--eval-size", type=int, default=8192)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=128, help="global batch")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--ratio", type=float, default=0.001)
+    ap.add_argument("--warmup-epochs", type=int, default=5)
+    ap.add_argument("--approx-recall", type=float, default=0.95)
+    ap.add_argument("--exact-select", action="store_true",
+                    help="force exact top-k selection (approx_recall=None)")
+    ap.add_argument("--eval-every", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+    if args.exact_select:
+        args.approx_recall = None
+
+    from dgc_tpu.compression.flat import ParamLayout
+    from dgc_tpu.models import resnet20
+    from dgc_tpu.training import make_loss_fn
+    from dgc_tpu.training.lr import cosine_schedule, make_lr_schedule
+    from dgc_tpu.utils.pytree import named_flatten
+
+    W = args.workers
+    bs_w = args.batch // W
+    steps_per_epoch = args.train_size // args.batch
+    print(f"workers={W} bs/worker={bs_w} steps/epoch={steps_per_epoch} "
+          f"sigma={args.sigma} classes={args.classes} "
+          f"subspace={args.subspace}", file=sys.stderr)
+
+    protos = jax.jit(
+        lambda k: make_protos(k, args.classes, args.subspace,
+                              proto_scale=args.proto_scale)
+    )(jax.random.PRNGKey(1234))
+    protos.block_until_ready()
+    print("protos ready on device", file=sys.stderr, flush=True)
+
+    model = resnet20(num_classes=args.classes)
+    loss_fn = make_loss_fn(model.apply)
+
+    results = {}
+    for arm in args.arms.split(","):
+        t_arm = time.time()
+        variables = model.init(jax.random.PRNGKey(args.seed),
+                               jnp.zeros((1, 32, 32, 3)), train=True)
+        lr_sched = make_lr_schedule(
+            args.lr, W, steps_per_epoch, warmup_lr_epochs=5,
+            decay=cosine_schedule(args.epochs))
+        comp, dist = build_arm(arm, variables, lr_sched, W, args.ratio,
+                               args.warmup_epochs, args)
+
+        layout = ParamLayout.for_compressor(variables["params"],
+                                            dist.compressor)
+        stats_layout = ParamLayout(variables.get("batch_stats", {}))
+        flat_params = layout.flatten(variables["params"])
+        flat_stats = stats_layout.flatten(variables.get("batch_stats", {}))
+        opt_state = dist.init(flat_params)
+
+        def make_epoch_fn(engine):
+            def worker(params_flat, stats_flat, mem, opt_state, xw, yw, key):
+                params = layout.unflatten(params_flat)
+                stats = stats_layout.unflatten(stats_flat)
+                (loss, new_stats), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, stats, xw, yw, 1.0, None)
+                fg = layout.flatten(grads)
+                key = jax.random.fold_in(key, jax.lax.axis_index(AX))
+                out, mem = engine.exchange(fg, mem, key, AX, W)
+                upd, opt_state = dist.optimizer.update(out, opt_state,
+                                                       params_flat)
+                return (params_flat + upd, stats_layout.flatten(new_stats),
+                        mem, opt_state, jax.lax.pmean(loss, AX))
+
+            vw = jax.vmap(worker,
+                          in_axes=(None, 0, 0, None, 0, 0, None),
+                          out_axes=(0, 0, 0, 0, 0),
+                          axis_name=AX)
+
+            @jax.jit
+            def epoch_fn(params_flat, stats_w, mem_w, opt_state, key):
+                def body(carry, i):
+                    params_flat, stats_w, mem_w, opt_state = carry
+                    bx, by = sample_batch(
+                        protos, jax.random.fold_in(key, 7000 + i),
+                        args.batch, args.sigma, args.classes,
+                        args.label_noise)
+                    x = bx.reshape(W, bs_w, 32, 32, 3)
+                    y = by.reshape(W, bs_w)
+                    kp, ss, mw, os2, loss = vw(
+                        params_flat, stats_w, mem_w, opt_state, x, y,
+                        jax.random.fold_in(key, 1 + i))
+                    return (kp[0], ss, mw, jax.tree.map(lambda a: a[0], os2)
+                            ), loss
+
+                (params_flat, stats_w, mem_w, opt_state), losses = (
+                    jax.lax.scan(body,
+                                 (params_flat, stats_w, mem_w, opt_state),
+                                 jnp.arange(steps_per_epoch)))
+                return params_flat, stats_w, mem_w, opt_state, losses.mean()
+            return epoch_fn
+
+        @jax.jit
+        def eval_fn(params_flat, stats0):
+            params = layout.unflatten(params_flat)
+            stats = stats_layout.unflatten(stats0)
+            variables_e = {"params": params}
+            if stats:
+                variables_e["batch_stats"] = stats
+
+            def body(correct, i):
+                # a FIXED held-out stream: eval keys are disjoint from
+                # every training key (different fold_in domain) and
+                # identical across epochs and arms
+                x, y = sample_batch(
+                    protos, jax.random.fold_in(jax.random.PRNGKey(555), i),
+                    512, args.sigma, args.classes, args.label_noise)
+                logits = model.apply(variables_e, x, train=False)
+                return correct + jnp.sum(jnp.argmax(logits, -1) == y), 0
+
+            n_chunks = args.eval_size // 512
+            correct, _ = jax.lax.scan(body, jnp.int32(0),
+                                      jnp.arange(n_chunks))
+            return correct / (n_chunks * 512)
+
+        # per-worker leading axes for stats + memory
+        stats_w = jnp.broadcast_to(flat_stats[None],
+                                   (W,) + flat_stats.shape)
+        engine = dist.make_flat(variables["params"])[1]
+        mem_w = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+            engine.init_memory())
+        epoch_fn = make_epoch_fn(engine)
+
+        curve = []
+        for epoch in range(args.epochs):
+            if arm != "dense" and comp.warmup_compress_ratio(epoch):
+                engine = dist.make_flat(variables["params"])[1]
+                epoch_fn = make_epoch_fn(engine)  # re-jit (<=6 ratios)
+            flat_params, stats_w, mem_w, opt_state, loss = epoch_fn(
+                flat_params, stats_w, mem_w, opt_state,
+                jax.random.fold_in(jax.random.PRNGKey(args.seed + 77),
+                                   epoch))
+            if epoch == 0:
+                print(f"[{arm}] first epoch dispatched "
+                      f"({time.time() - t_arm:.0f}s incl. compile)",
+                      file=sys.stderr, flush=True)
+            if (epoch + 1) % args.eval_every == 0 or epoch == args.epochs - 1:
+                acc = float(eval_fn(flat_params, stats_w[0]))
+                curve.append((epoch, float(loss), acc))
+                print(f"[{arm}] epoch {epoch:3d} loss {float(loss):.4f} "
+                      f"top1 {acc * 100:.2f}%"
+                      + (f" ratio {comp.compress_ratio}"
+                         if arm != "dense" else ""),
+                      file=sys.stderr, flush=True)
+        final5 = [a for _, _, a in curve[-3:]]
+        results[arm] = {"final_top1": max(final5), "curve": curve,
+                        "wall_s": round(time.time() - t_arm, 1)}
+        print(f"[{arm}] done in {results[arm]['wall_s']}s "
+              f"final top1 {max(final5) * 100:.2f}%", file=sys.stderr)
+
+    print(json.dumps(results))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Input-pipeline throughput bench: ImageFolder decode+augment img/s.
+
+Generates a small synthetic JPEG image folder, then measures
+``_ImageFolderSplit.get_batch`` throughput at several worker-pool sizes.
+The reference consumed ~1300 img/s at its ImageNet operating point (bs 32
+at 25 ms/step); sustaining that needs decode parallelism = the torch
+DataLoader ``num_workers`` role (reference train.py:96-107).
+
+Prints one JSON line: {"img_per_s": {workers: rate}, "cores": N}.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def make_folder(root, classes=4, per_class=64, size=(320, 280)):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for c in range(classes):
+        d = os.path.join(root, f"n{c:04d}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.randint(0, 255, size + (3,), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"im{i:04d}.jpg"),
+                                      quality=85)
+
+
+def main():
+    from dgc_tpu.data.datasets import _ImageFolderSplit
+
+    with tempfile.TemporaryDirectory() as root:
+        make_folder(root)
+        out = {}
+        for workers in (1, 2, 4, os.cpu_count() or 1):
+            split = _ImageFolderSplit(root, 224, train=True, workers=workers)
+            n = len(split)
+            idx = np.arange(n)
+            split.get_batch(idx[:8])          # warm pool + page cache
+            t0 = time.perf_counter()
+            reps = 3
+            for r in range(reps):
+                split.get_batch(idx)
+            dt = time.perf_counter() - t0
+            out[workers] = round(reps * n / dt, 1)
+            split.close()
+            print(f"workers={workers}: {out[workers]} img/s",
+                  file=sys.stderr)
+        print(json.dumps({"img_per_s": out, "cores": os.cpu_count()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,10 +2,17 @@
 ResNet-50 / ratio 0.001 shapes on the real TPU chip.
 
 Same scan-K + one-scalar-readback methodology as bench.py (the relay's
-block_until_ready lies; per-call dispatch drifts). Each stage runs K times
-inside one jitted lax.scan with a data dependency threaded through, then
-one forced readback; the relay RTT is subtracted and the remainder
-amortized.
+block_until_ready lies; per-call dispatch drifts — if that methodology
+changes in bench.py, update measure_rtt/time_scan here to match). Each
+stage runs K times inside one jitted lax.scan with a data dependency
+threaded through, then one forced readback; the relay RTT is subtracted
+and the remainder amortized. Every stage calls ENGINE code (not inlined
+re-implementations, which go stale); for finer attribution take a device
+profile (jax.profiler.trace) and aggregate the XLA-op durations.
+
+Known bias: isolated stages carry a ~1 ms per-scan-iteration floor on
+this backend — compare stages to each other, not to the paired full-step
+difference (the honest end-to-end number).
 
 Usage: python scripts/bench_stages.py [--model resnet50|resnet20] [--k 30]
 """
@@ -149,114 +156,22 @@ def main():
                        f"cols={saved[bi].cols})")
     engine.buckets = saved
 
-    # --- inside-bucket breakdown for the big (adaptive) buckets ---
-    for bi, b in enumerate(saved):
-        if b.exact or b.rows * b.cols * 4 < 16 * 1024 * 1024:
-            continue
-        R, cols = b.rows, b.cols
-        block0 = gc[b.base:b.base + R * cols].reshape(R, cols)
-        numels = jnp.asarray(b.numels)[:, None]
-        col = jnp.arange(cols, dtype=jnp.int32)[None, :]
-        imp0 = jnp.where(col < numels, jnp.abs(block0), -1.0)
-
-        def imp_stage(c):
-            blk, acc = c
-            imp = jnp.where(col < numels, jnp.abs(blk), -1.0)
-            return (blk * 0.999, acc + imp[0, 0])
-
-        time_scan(imp_stage, (block0, jnp.float32(0)), args.k, rtt,
-                  name=f"  b{bi} importance mask [R,cols]")
-
-        strides = jnp.asarray(b.strides)[:, None]
-        s_idx = jnp.arange(b.max_s, dtype=jnp.int32)[None, :]
-        s_valid = s_idx < jnp.asarray(b.num_samples)[:, None]
-
-        def sample_stage(c):
-            imp, acc = c
-            u = jax.random.uniform(key, (R, 1))
-            phase = jnp.floor(u * strides).astype(jnp.int32)
-            pos = phase + s_idx * strides
-            samples = jnp.where(
-                s_valid,
-                jnp.take_along_axis(imp, jnp.minimum(pos, cols - 1), axis=1),
-                -1.0)
-            return (imp * 0.999, acc + jnp.sum(samples[:, :2]))
-
-        time_scan(sample_stage, (imp0, jnp.float32(0)), args.k, rtt,
-                  name=f"  b{bi} strided sample gather [R,{b.max_s}]")
-
-        u = jax.random.uniform(key, (R, 1))
-        phase = jnp.floor(u * strides).astype(jnp.int32)
-        pos = phase + s_idx * strides
-        samples0 = jnp.where(
-            s_valid,
-            jnp.take_along_axis(imp0, jnp.minimum(pos, cols - 1), axis=1),
-            -1.0)
-
-        def thr_stage(c):
-            smp, acc = c
-            sorted_s = jax.lax.top_k(smp, b.max_k)[0]
-            thr = jnp.take_along_axis(
-                sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
-                axis=1)[:, 0]
-            return (smp * 0.999, acc + jnp.sum(thr))
-
-        time_scan(thr_stage, (samples0, jnp.float32(0)), args.k, rtt,
-                  name=f"  b{bi} threshold top_k over samples")
-
-        from dgc_tpu.ops import kernels as kk
-        thr0 = jnp.abs(jnp.asarray(rng.randn(R), jnp.float32)) * 1e-2
-
-        def ladder_stage(c):
-            imp, acc = c
-            counts = kk.ladder_counts(imp, thr0, comp.compress_lower_bound,
-                                      comp.max_adaptation_iters + 1)
-            return (imp * 0.999, acc + jnp.sum(counts))
-
-        time_scan(ladder_stage, (imp0, jnp.float32(0)), args.k, rtt,
-                  name=f"  b{bi} ladder counts kernel")
-
-        def select_stage(c):
-            imp, acc = c
-            scores = jnp.where(imp >= thr0[:, None], imp,
-                               -jnp.ones_like(imp))
-            tv, ti = jax.lax.approx_max_k(scores, b.max_sel,
-                                          recall_target=0.95)
-            return (imp * 0.999, acc + jnp.sum(tv) + jnp.sum(ti))
-
-        time_scan(select_stage, (imp0, jnp.float32(0)), args.k, rtt,
-                  name=f"  b{bi} mask+approx_max_k k={b.max_sel}")
-
-        def select_nomask(c):
-            imp, acc = c
-            tv, ti = jax.lax.approx_max_k(imp, b.max_sel,
-                                          recall_target=0.95)
-            return (imp * 0.999, acc + jnp.sum(tv) + jnp.sum(ti))
-
-        time_scan(select_nomask, (imp0, jnp.float32(0)), args.k, rtt,
-                  name=f"  b{bi} approx_max_k alone k={b.max_sel}")
-
-        tv0, ti0 = jax.jit(lambda s: jax.lax.approx_max_k(
-            s, b.max_sel, recall_target=0.95))(imp0)
-
-        def gather_vals(c):
-            blk, acc = c
-            vals = jnp.take_along_axis(blk, ti0, axis=1)
-            return (blk * 0.999, acc + jnp.sum(vals))
-
-        time_scan(gather_vals, (block0, jnp.float32(0)), args.k, rtt,
-                  name=f"  b{bi} value gather [R,{b.max_sel}]")
+    # (round-1 carried hand-inlined sub-stage benches here; they
+    # re-implemented engine internals and went stale the moment the engine
+    # changed — per-stage attribution now comes from the device PROFILE
+    # (jax.profiler trace + XLA-op aggregation), which always measures the
+    # shipped code. The stages below call engine code directly.)
 
     # --- masking + scatter-add decompress ---
     vals0, idx0 = jax.jit(lambda v, k: engine.sparsify(v, k))(gc, key)
 
-    def mask_stage(c):
-        vv, mm = c
-        vv = vv.at[idx0].set(0.0)
-        mm = mm.at[idx0].set(0.0)
-        return (vv * 0.999, mm * 0.999)
+    def keep_stage(c):
+        vv, acc = c
+        keep = jnp.ones((T,), jnp.float32).at[idx0].set(0.0)
+        return (vv * 0.999, acc + keep[0])
 
-    time_scan(mask_stage, (vc, mc), args.k, rtt, name="masking 2x scatter")
+    time_scan(keep_stage, (vc, jnp.float32(0)), args.k, rtt,
+              name="keep-mask scatter (fresh ones)")
 
     def scatter_stage(c):
         acc = jnp.zeros((T,), jnp.float32)

@@ -1,0 +1,63 @@
+"""Measure the flat engine's approx-selection recall on the real TPU at
+the ResNet-50 operating shapes (VERDICT round-1 item 2 / ADVICE item 3).
+
+For each adaptive bucket of the ResNet-50 / ratio-0.001 layout, draws
+gradient-like inputs (Gaussian and heavy-tailed — real gradients are
+leptokurtic, which is the easier case for top-k recall) and reports the
+fraction of the EXACT top-num_selects coordinates that the engine's
+approx path (approx_max_k no-aggregate + candidate top-k) recovers.
+
+Prints one JSON line {bucket: {"shape", "k", "recall_gauss", "recall_t"}}.
+Exact reference selections are computed with lax.top_k on the same device.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from dgc_tpu import DGCCompressor, DGCSGDMemory
+    from dgc_tpu.compression.flat import FlatDGCEngine, ParamLayout
+    from dgc_tpu.models import resnet50
+    from dgc_tpu.utils.pytree import named_flatten
+
+    model = resnet50()
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                   train=True)
+    named, _ = named_flatten(v["params"])
+    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    layout = ParamLayout.for_compressor(v["params"], comp)
+    engine = FlatDGCEngine(comp, layout)
+
+    rng = np.random.RandomState(0)
+    out = {}
+    for bi, b in enumerate(engine.buckets):
+        R, cols, k = b.rows, b.cols, b.max_sel
+        if k <= 128 and cols < 32768:
+            continue  # exact path
+        rec = {}
+        for name, draw in (
+                ("gauss", lambda: rng.randn(R, cols)),
+                ("student_t3", lambda: rng.standard_t(3, (R, cols)))):
+            x = jax.device_put(jnp.abs(jnp.asarray(draw(), jnp.float32)))
+            av, ai = jax.jit(lambda s: engine._select_topk(s, k))(x)
+            ev, ei = jax.jit(lambda s: jax.lax.top_k(s, k))(x)
+            ai_n, ei_n = np.asarray(ai), np.asarray(ei)
+            hits = [len(np.intersect1d(ai_n[r], ei_n[r])) / k
+                    for r in range(R)]
+            rec[name] = round(float(np.mean(hits)), 4)
+        out[f"bucket{bi}"] = {"shape": [R, cols], "k": k, **rec}
+        print(f"bucket{bi} [{R},{cols}] k={k}: {rec}", file=sys.stderr)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Worker program for the 2-process ``jax.distributed`` CPU test
+(tests/test_multiprocess.py) — the multi-host execution path the reference
+exercised with 8-256 MPI ranks (/root/reference/train.py:99-100,244-264).
+
+Each process: initialize the process group over gRPC, build a mesh spanning
+BOTH processes' fake CPU devices, assemble the global batch from its local
+shard (``host_local_to_global``), run flat DGC train steps, save a
+checkpoint collectively (orbax distributed write, coordinator-only
+bookkeeping), restore it, and verify the restored state matches. Prints
+one JSON result line prefixed RESULT: for the parent to parse.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    num_procs = int(sys.argv[2])
+    coord = sys.argv[3]
+    workdir = sys.argv[4]
+
+    from dgc_tpu.parallel.multihost import (
+        host_local_to_global, initialize_multihost, is_coordinator)
+
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coord
+    os.environ["JAX_NUM_PROCESSES"] = str(num_procs)
+    os.environ["JAX_PROCESS_ID"] = str(proc_id)
+    assert initialize_multihost() is True
+    assert jax.process_count() == num_procs
+    assert is_coordinator() == (proc_id == 0)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+    from jax.sharding import Mesh
+
+    from dgc_tpu import (DGCCompressor, DGCSGDMemory, DistributedOptimizer,
+                         dgc_sgd)
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    from dgc_tpu.utils.logging import MetricWriter
+    from dgc_tpu.utils.pytree import named_flatten
+
+    W = len(jax.devices())          # 8 global (4 per process)
+    assert W == 2 * 4
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x.mean(axis=(1, 2)))
+
+    model = M()
+    v = dict(model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3))))
+
+    def apply_fn(variables, x, train=True, mutable=None, rngs=None):
+        if mutable:
+            return model.apply(variables, x, train=train, mutable=mutable,
+                               rngs=rngs)
+        return model.apply(variables, x, train=train)
+
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9))
+    named, _ = named_flatten(v["params"])
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    setup = make_flat_setup(v, dist)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh,
+                        dist_opt=dist)
+    step_fn = build_train_step(apply_fn, dist, mesh, donate=False,
+                               flat=setup)
+
+    # every process materializes the full host batch; host_local_to_global
+    # takes each process's local slice (the DistributedSampler role)
+    rng = np.random.RandomState(7)
+    bs = 4
+    images_h = rng.randn(W * bs, 16, 16, 3).astype(np.float32)
+    labels_h = rng.randint(0, 10, W * bs).astype(np.int32)
+    images = host_local_to_global(images_h, mesh)
+    labels = host_local_to_global(labels_h, mesh)
+
+    losses = []
+    for i in range(3):
+        state, m = step_fn(state, images, labels, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+
+    # metric writer: only the coordinator creates files
+    writer = MetricWriter(os.path.join(workdir, "logs"))
+    writer.add_scalar("loss", losses[-1], 3)
+    writer.close()
+
+    ckpt = CheckpointManager(os.path.join(workdir, "ckpt"), keep=3)
+    ckpt.save(0, state, {"top1": 12.5}, best=True)
+
+    # one more step so the live state diverges from the saved one
+    state2, _ = step_fn(state, images, labels, jax.random.PRNGKey(99))
+    restored = ckpt.restore(state2)
+    assert restored is not None
+    r_state, r_epoch, meters = restored
+    assert r_epoch == 0 and abs(meters["top1"] - 12.5) < 1e-6
+
+    # restored params equal the saved (pre-divergence) params, not state2's
+    def gather(x):
+        # params are replicated: any local shard holds the full value
+        return np.asarray(x.addressable_data(0))
+
+    saved_p = gather(state.params)
+    rest_p = gather(r_state.params)
+    div_p = gather(state2.params)
+    np.testing.assert_allclose(rest_p, saved_p, rtol=1e-6)
+    assert not np.allclose(rest_p, div_p)
+
+    # resumed state trains on
+    state3, m3 = step_fn(r_state, images, labels, jax.random.PRNGKey(5))
+    assert np.isfinite(float(m3["loss"]))
+
+    print("RESULT:" + json.dumps({
+        "proc": proc_id,
+        "losses": losses,
+        "resume_loss": float(m3["loss"]),
+        "coordinator": is_coordinator(),
+    }), flush=True)
+
+    # align exits: the coordinator's extra file bookkeeping must not make
+    # the other process hit the jax shutdown barrier alone and time out
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("test_done")
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

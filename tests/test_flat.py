@@ -206,6 +206,90 @@ def test_flat_matches_per_tensor_exchange(mesh8, nesterov, momentum_masking):
                     err_msg=f"{mkey} step {step} {n}")
 
 
+def test_warmup_ratio_rebuild_equivalence(mesh8):
+    """The full wm5 warm-up schedule (6 ratio changes, reference
+    compression.py:91-107) driven through the FLAT ENGINE REBUILD path:
+    each ratio change rebuilds the engine (new static attrs, re-jit) while
+    the memory buffers — including a pending deferred transmit mask from
+    the previous ratio's last step — carry over untouched. The flat path
+    must stay step-for-step identical to the per-tensor oracle across
+    every transition (sample_ratio=1.0 makes selection deterministic)."""
+    params = _params()
+    named, _ = named_flatten(params)
+
+    def mk():
+        comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0, warmup_epochs=5)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        return comp, DistributedOptimizer(
+            dgc_sgd(0.1, momentum=0.9), comp, world_size=W)
+
+    comp_f, dist_f = mk()
+    comp_p, dist_p = mk()
+
+    rng = np.random.RandomState(3)
+    grads_w = {n: jnp.asarray(rng.randn(W, *p.shape), jnp.float32)
+               for n, p in named.items()}
+    from dgc_tpu.utils.pytree import named_unflatten
+
+    def worker_tree(w):
+        return named_unflatten({n: grads_w[n][w] for n in named},
+                               named_flatten(params)[1])
+
+    mem_f = mem_p = None
+    layout0 = None
+    ratios, payloads = [], []
+    for epoch in range(7):
+        ch_f = comp_f.warmup_compress_ratio(epoch)
+        assert ch_f == comp_p.warmup_compress_ratio(epoch)
+        assert ch_f == (epoch <= 5)
+        layout, engine = dist_f.make_flat(params)   # the rebuild
+        if layout0 is None:
+            layout0 = layout
+            flat_grads_w = jnp.stack(
+                [layout.flatten(worker_tree(w)) for w in range(W)])
+            mem_f = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                engine.init_memory())
+            mem_p = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                dist_p.init_memory(params))
+        # memory shapes are ratio-independent: the rebuilt engine adopts
+        # the carried buffers with no conversion
+        ratios.append(round(comp_f.compress_ratio, 4))
+        payloads.append(engine.payload_size)
+        flat_fn = _flat_exchange_fn(dist_f, engine, mesh8)
+        pt_fn = _pt_exchange_fn(dist_p, mesh8)
+        for s in range(2):
+            key = jax.random.PRNGKey(epoch * 10 + s)
+            out_f, mem_f = flat_fn(flat_grads_w, mem_f, key)
+            out_p, mem_p = pt_fn(grads_w, mem_p, key)
+            assert np.isfinite(np.asarray(out_f)).all()
+            named_out_p, _ = named_flatten(out_p)
+            named_out_f = layout.unflatten_named(out_f[0])
+            for n in layout.names:
+                np.testing.assert_allclose(
+                    np.asarray(named_out_f[n]).reshape(-1),
+                    np.asarray(named_out_p[n][0]).reshape(-1),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"epoch {epoch} step {s} {n}")
+        full_f = _mem_full(engine, mem_f, w=0)
+        for mkey in ("momentums", "velocities"):
+            named_m_f = layout.unflatten_named(full_f[mkey], keep_1d=True)
+            for n in layout.names:
+                np.testing.assert_allclose(
+                    np.asarray(named_m_f[n]),
+                    np.asarray(mem_p[mkey][n][0]).reshape(-1),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"{mkey} epoch {epoch} {n}")
+    assert ratios == [0.3162, 0.1, 0.0316, 0.01, 0.0032, 0.001, 0.001]
+    # payload shrinks with the ratio and is constant once warm-up ends
+    assert payloads == sorted(payloads, reverse=True)
+    assert payloads[-1] == payloads[-2]
+    # error feedback survived to the end: residuals accumulated
+    assert np.abs(full_f["velocities"]).sum() > 0
+
+
 def test_flat_payload_matches_reference_wire_volume():
     """The tight payload is exactly sum(num_selects) — the reference's wire
     size (compression.py:151), no padding inflation."""

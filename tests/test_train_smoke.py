@@ -123,3 +123,66 @@ def test_micro_batch_accumulation_equivalence(mesh8, setup):
     l2 = one(2, imgs2, lbls2)
     # duplicated micro-batches: mean loss identical
     assert l1 == pytest.approx(l2, rel=1e-5)
+
+
+def test_warmup_rebuild_full_flat_train_step(mesh8):
+    """train.py's per-epoch rebuild loop (train.py rebuild logic; reference
+    compression.py:91-107) at the FULL flat train-step level: the wm5
+    schedule's 6 ratio changes each rebuild the engine + re-jit the step
+    while the train state (params, optimizer, error-feedback memory with a
+    pending deferred mask) carries across; loss must stay finite and the
+    memory must visibly survive each re-layout."""
+    from flax import linen as nn
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state)
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.relu(x)
+            x = nn.Conv(16, (3, 3))(x)
+            x = nn.relu(x).mean(axis=(1, 2))
+            return nn.Dense(10)(x)
+
+    model = M()
+    v = {"params": model.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 16, 16, 3)))["params"],
+         "batch_stats": {}}
+
+    def apply_fn(variables, x, train=True, mutable=None, rngs=None):
+        out = model.apply({"params": variables["params"]}, x, train=train)
+        return (out, {"batch_stats": {}}) if mutable else out
+
+    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
+                         warmup_epochs=5)
+    named, _ = named_flatten(v["params"])
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(
+        dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W)
+
+    setup = make_flat_setup(v, dist)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh8,
+                        dist_opt=dist)
+    npr = np.random.RandomState(5)
+    images = jnp.asarray(npr.randn(W * 4, 16, 16, 3), jnp.float32)
+    labels = jnp.asarray(npr.randint(0, 10, W * 4), jnp.int32)
+
+    step_fn = None
+    vel_sums = []
+    for epoch in range(7):
+        if comp.warmup_compress_ratio(epoch) or step_fn is None:
+            setup = make_flat_setup(v, dist)
+            step_fn = build_train_step(apply_fn, dist, mesh8, donate=False,
+                                       flat=setup)
+        for s in range(2):
+            state, m = step_fn(state, images, labels,
+                               jax.random.PRNGKey(epoch * 10 + s))
+            assert np.isfinite(float(m["loss"])), (epoch, s)
+        vel = np.abs(np.asarray(jax.device_get(
+            state.memory["velocities_c"]))).sum()
+        vel_sums.append(float(vel))
+    assert comp.compress_ratio == 0.001
+    # error feedback accumulated and survived every re-layout (a reset
+    # buffer would drop back to ~0 right after a rebuild)
+    assert all(vs > 0 for vs in vel_sums[1:]), vel_sums

@@ -205,8 +205,14 @@ def main():
         ckpt.latest_epoch() is not None or args.evaluate) else None
     if restored is not None:
         host_state, last_epoch, meters = restored
-        state = shard_state(jax.tree.map(jnp.asarray, host_state), mesh, axis,
-                            dist_opt=dist)
+        if jax.process_count() > 1:
+            # multi-host restore already produced global sharded arrays
+            # placed by the template's shardings — no re-shard possible
+            # (host materialization of non-addressable arrays would throw)
+            state = host_state
+        else:
+            state = shard_state(jax.tree.map(jnp.asarray, host_state), mesh,
+                                axis, dist_opt=dist)
         best_metric = meters.get(configs.train.metric + "_best")
         printr(f"\n[resumed] epoch {last_epoch}, best {best_metric}")
     else:
