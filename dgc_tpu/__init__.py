@@ -12,27 +12,41 @@ The reference's plugin boundary survives as typed interfaces (see
 `dgc_tpu.compression.base.Compressor` and `dgc_tpu.compression.memory.Memory`):
 compressors expose compress/decompress/communicate, memories expose
 compensate/update, and the distributed optimizer is generic over both.
+
+Top-level names resolve LAZILY (PEP 562): importing the package does not pull
+jax/flax/optax. That keeps light consumers light — in particular the spawned
+image-decode pool workers (`dgc_tpu.data.datasets._decode_one`) import only
+PIL+numpy instead of paying seconds of jax import and hundreds of MB of RSS
+per worker.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
-from dgc_tpu.compression.dgc import DGCCompressor
-from dgc_tpu.compression.memory import Memory, DGCSGDMemory
-from dgc_tpu.compression.base import Compressor, NoneCompressor, FP16Compressor, Compression
-from dgc_tpu.optim.sgd import dgc_sgd, sgd
-from dgc_tpu.optim.distributed import DistributedOptimizer
-from dgc_tpu.optim.adasum import AdasumDistributedOptimizer
+_EXPORTS = {
+    "DGCCompressor": "dgc_tpu.compression.dgc",
+    "Memory": "dgc_tpu.compression.memory",
+    "DGCSGDMemory": "dgc_tpu.compression.memory",
+    "Compressor": "dgc_tpu.compression.base",
+    "NoneCompressor": "dgc_tpu.compression.base",
+    "FP16Compressor": "dgc_tpu.compression.base",
+    "Compression": "dgc_tpu.compression.base",
+    "dgc_sgd": "dgc_tpu.optim.sgd",
+    "sgd": "dgc_tpu.optim.sgd",
+    "DistributedOptimizer": "dgc_tpu.optim.distributed",
+    "AdasumDistributedOptimizer": "dgc_tpu.optim.adasum",
+}
 
-__all__ = [
-    "DGCCompressor",
-    "Memory",
-    "DGCSGDMemory",
-    "Compressor",
-    "NoneCompressor",
-    "FP16Compressor",
-    "Compression",
-    "dgc_sgd",
-    "sgd",
-    "DistributedOptimizer",
-    "AdasumDistributedOptimizer",
-]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+        value = getattr(importlib.import_module(_EXPORTS[name]), name)
+        globals()[name] = value        # cache: resolve once
+        return value
+    raise AttributeError(f"module 'dgc_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

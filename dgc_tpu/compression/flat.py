@@ -553,20 +553,15 @@ class FlatDGCEngine:
         equivalence tests see identical selections."""
         r = self.c.approx_recall
         if r is not None and max_sel > 128:
-            if kernels.use_pallas():
-                # TPU: aggregate_to_topk=False + a manual lax.top_k over
-                # the [R, l] candidate set — same candidates, same recall,
-                # but the built-in aggregation (a variadic sort) measured
-                # 0.53 ms vs 0.09 ms for this split at the ResNet-50
-                # big-bucket shapes on v5e
-                cv, ci = jax.lax.approx_max_k(scores, max_sel,
-                                              recall_target=float(r),
-                                              aggregate_to_topk=False)
-                v2, i2 = jax.lax.top_k(cv, max_sel)
-                return v2, jnp.take_along_axis(ci, i2, axis=1)
-            # CPU/other: the aggregated form falls back to an EXACT sort
-            # (the equivalence suite relies on that); aggregate_to_topk=
-            # False would force the partial-reduce op and lose recall
+            # the AGGREGATED form, deliberately: splitting into
+            # aggregate_to_topk=False + a manual lax.top_k over the
+            # candidates looked faster in isolated micro-benches but those
+            # were DCE-corrupted — the honest paired full-step A/B at
+            # ResNet-50 measures the aggregated form ~0.55 ms/step FASTER
+            # than the split on v5e. On CPU it also lowers to an exact
+            # sort, which the flat-vs-per-tensor equivalence suite relies
+            # on (no-aggregate would force the partial-reduce op there and
+            # lose recall).
             return jax.lax.approx_max_k(scores, max_sel,
                                         recall_target=float(r))
         return _exact_topk(scores, max_sel)

@@ -38,7 +38,7 @@ def main():
     with tempfile.TemporaryDirectory() as root:
         make_folder(root)
         out = {}
-        for workers in (1, 2, 4, os.cpu_count() or 1):
+        for workers in dict.fromkeys((1, 2, 4, os.cpu_count() or 1)):
             split = _ImageFolderSplit(root, 224, train=True, workers=workers)
             n = len(split)
             idx = np.arange(n)

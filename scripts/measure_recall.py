@@ -1,11 +1,12 @@
 """Measure the flat engine's approx-selection recall on the real TPU at
 the ResNet-50 operating shapes (VERDICT round-1 item 2 / ADVICE item 3).
 
-For each adaptive bucket of the ResNet-50 / ratio-0.001 layout, draws
-gradient-like inputs (Gaussian and heavy-tailed — real gradients are
-leptokurtic, which is the easier case for top-k recall) and reports the
-fraction of the EXACT top-num_selects coordinates that the engine's
-approx path (approx_max_k no-aggregate + candidate top-k) recovers.
+For each bucket of the ResNet-50 / ratio-0.001 layout where the engine's
+approx path engages (max_sel > 128 — the gate in
+FlatDGCEngine._select_topk), draws gradient-like inputs (Gaussian and
+heavy-tailed — real gradients are leptokurtic, which is the easier case
+for top-k recall) and reports the fraction of the EXACT top-num_selects
+coordinates that the engine's selection recovers.
 
 Prints one JSON line {bucket: {"shape", "k", "recall_gauss", "recall_t"}}.
 Exact reference selections are computed with lax.top_k on the same device.
@@ -41,8 +42,8 @@ def main():
     out = {}
     for bi, b in enumerate(engine.buckets):
         R, cols, k = b.rows, b.cols, b.max_sel
-        if k <= 128 and cols < 32768:
-            continue  # exact path
+        if k <= 128:
+            continue  # exact path (the engine gate: max_sel > 128)
         rec = {}
         for name, draw in (
                 ("gauss", lambda: rng.randn(R, cols)),

@@ -27,17 +27,22 @@ def test_two_process_train_save_resume(tmp_path):
     worker = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    # log to FILES, not PIPEs: sequential communicate() would deadlock if
+    # the other process fills its 64KB pipe while both sit at a collective
+    # barrier
+    logs = [open(tmp_path / f"worker{i}.log", "w+") for i in range(2)]
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(i), "2", coord, str(tmp_path)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
+            stdout=logs[i], stderr=subprocess.STDOUT, text=True, env=env)
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=570)
-        outs.append(out)
+    for p, lf in zip(procs, logs):
+        p.wait(timeout=570)
+        lf.seek(0)
+        outs.append(lf.read())
+        lf.close()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
     results = {}
