@@ -91,20 +91,27 @@ def _make_k_loop(step_fn, images, labels, k):
     return k_loop
 
 
-def _interleaved_step_ms(runs, rtt_ms, k=K_STEPS, repeats=REPEATS):
+def _interleaved_step_ms(runs, rtt_ms, k=K_STEPS, repeats=REPEATS,
+                         max_repeats=3 * REPEATS):
     """Per-step device time for several (k_loop, state) configs, with the
     timed rounds INTERLEAVED so slow drift in the relay link hits every
     config equally (back-to-back runs minutes apart drift by more than the
     differences being measured). Returns the per-round rows — consumers
     compare configs with the PAIRED per-round values (median of
     within-round differences), which cancels drift far better than
-    differencing each config's independent minimum."""
+    differencing each config's independent minimum.
+
+    Rounds extend adaptively (up to ``max_repeats``) while the paired
+    differences are unstable: a bad link phase throws multi-ms spikes that
+    can corrupt half the rounds, and the single driver-recorded run must
+    survive landing in one."""
     states, rows = [], []
     for k_loop, state in runs:
         state, _ = k_loop(state, jax.random.PRNGKey(0))   # compile + warm
         _ = float(_ssum(state.params))
         states.append(state)
-    for r in range(repeats):
+    r = 0
+    while True:
         row = []
         for j, (k_loop, _) in enumerate(runs):
             t0 = time.perf_counter()
@@ -112,6 +119,23 @@ def _interleaved_step_ms(runs, rtt_ms, k=K_STEPS, repeats=REPEATS):
             _ = float(_ssum(states[j].params))   # blocks until all K ran
             row.append(((time.perf_counter() - t0) * 1e3 - rtt_ms) / k)
         rows.append(row)
+        r += 1
+        if r < repeats:
+            continue
+        if r >= max_repeats:
+            break
+        # stability is judged on the FIRST config paired against the LAST
+        # (main() passes [dgc, dense]); generalizes to any >= 2 configs
+        diffs = [row[0] - row[-1] for row in rows]
+        med = statistics.median(diffs)
+        # median absolute deviation: stop when half the rounds agree with
+        # the median to within 25% (or 0.05 ms, whichever is looser)
+        mad = statistics.median(abs(d - med) for d in diffs)
+        if mad <= max(0.25 * abs(med), 0.05):
+            break
+        print(f"[round {r}] paired diffs unstable "
+              f"(median {med:.3f}, MAD {mad:.3f}) -> extending",
+              file=sys.stderr)
     return rows
 
 

@@ -321,12 +321,15 @@ def _build_buckets(attributes, layout: ParamLayout) -> List[_Bucket]:
 
 def _exact_topk(x: jax.Array, k: int):
     """Exact per-row top-k: the Pallas iterative-max kernel on TPU (bitwise
-    lax.top_k-compatible, kernels.topk_rows — it self-gates on k <= lane
-    width and VMEM budget and falls back to lax.top_k otherwise; measured
-    faster than XLA's sort-based lowering at the engine's small-k shapes,
-    e.g. 0.42 -> ~0.1 ms on a [19, 65536] k=66 bucket), plain lax.top_k
-    elsewhere (the interpreter would be slower than the native sort)."""
-    if kernels.use_pallas():
+    lax.top_k-compatible, kernels.topk_rows) where its k sequential
+    max-extractions cost less than XLA's sort-based lowering — measured
+    crossover ~2M element-extractions per row block on v5e (ResNet-20's
+    [22, 36864] k=37 bucket: kernel 0.14 vs sort 0.16 ms; ResNet-50's
+    [19, 65536] k=66: kernel 0.52 vs sort 0.42 ms, device profile).
+    topk_rows additionally self-gates on k <= lane width and VMEM budget;
+    off-TPU always lax.top_k (the interpreter would be slower than the
+    native sort)."""
+    if kernels.use_pallas() and k * x.shape[1] <= 2_000_000:
         return kernels.topk_rows(x, k)
     return jax.lax.top_k(x, k)
 
