@@ -770,7 +770,8 @@ class FlatDGCEngine:
         return total / world_size if op == "average" else total
 
     def exchange(self, flat_grad: jax.Array, mem: Dict, key: jax.Array,
-                 axis_name: str, world_size: int, op: str = "average"):
+                 axis_name: str, world_size: int, op: str = "average",
+                 local_axis: Optional[str] = None, local_size: int = 1):
         """compress -> communicate -> decompress over the whole model:
         two ``all_gather`` + one ``psum`` per step, total.
 
@@ -779,10 +780,36 @@ class FlatDGCEngine:
         Compressed payloads divide by world size ONLY for "average"
         (reference compression.py:192-193).
 
+        **Two-tier hierarchical mode** (``local_axis`` set): the real form
+        of the reference's "#Sparsified Nodes < #GPUs" regime — which it can
+        only *simulate* through ``num_batches_per_step`` micro-batching
+        (/root/reference/README.md:126-128,133-134,
+        dgc/horovod/optimizer.py:70-72) — dense aggregation over the
+        near-free ICI axis first (one full-precision ``psum`` over
+        ``local_axis``, averaged over ``local_size``), then the whole DGC
+        pipeline (compensate -> sparsify -> gather -> scatter-add) runs on
+        the *node-aggregated* gradient with only ``axis_name`` (the
+        DCN/host axis) as the sparse exchange group. ``world_size`` is then
+        the number of sparsified nodes. Error-feedback memory is per-node
+        (identical across a node's workers by construction: same node
+        gradient, same selection key — the step builder shares the sparsify
+        key within a local group).
+
         With no initialized compressed tensors (T == 0, e.g. an uninitialized
         compressor) every parameter falls through to the dense block —
         the same graceful degradation as the per-tensor path's
         ``name in attributes`` guard."""
+        if local_axis is not None and local_size > 1:
+            if op == "adasum":
+                raise NotImplementedError(
+                    "hierarchical two-tier exchange composes with average/"
+                    "sum only; Adasum's pairwise reduction has no node-"
+                    "aggregated form here")
+            # dense-over-ICI tier: full-precision node aggregation (the
+            # fp16 wire option applies to the slow DCN link only)
+            flat_grad = jax.lax.psum(flat_grad, local_axis)
+            if op == "average":
+                flat_grad = flat_grad / local_size
         T, P = self.T, self.layout.total
         m = self._mem
         clip = m.gradient_clipping if m is not None else None
@@ -947,11 +974,25 @@ class FlatDenseExchange:
         return {}
 
     def exchange(self, flat_grad, mem, key, axis_name, world_size,
-                 op: str = "average"):
+                 op: str = "average", local_axis: Optional[str] = None,
+                 local_size: int = 1):
         if op == "adasum":
+            if local_axis is not None and local_size > 1:
+                raise NotImplementedError(
+                    "hierarchical two-tier exchange composes with average/"
+                    "sum only")
             # full precision: fp16 dot/norm accumulations would overflow
             from dgc_tpu.optim.adasum import adasum_allreduce
             return adasum_allreduce(flat_grad, axis_name, world_size), mem
+        hier = local_axis is not None and local_size > 1
+        if hier:
+            # full-precision ICI tier first; the (optional fp16) wire cast
+            # applies to the cross-host link only, like the DGC engine.
+            # Average divides BEFORE the wire cast — an undivided node sum
+            # on an fp16 wire would overflow local_size x earlier.
+            flat_grad = jax.lax.psum(flat_grad, local_axis)
+            if op == "average":
+                flat_grad = flat_grad / local_size
         wire = self.c._wire(flat_grad)
         total = self.c._unwire(jax.lax.psum(wire, axis_name),
                                flat_grad.dtype)

@@ -347,13 +347,17 @@ def topk_rows(x: jax.Array, k: int):
     stalls where lax.top_k would surface the NaN first).
 
     One VMEM-resident pass per row block: k sequential max-extractions.
-    Measured on v5e at the engine's operating points ([8, 36864] k=37:
-    0.242 ms vs lax.top_k's 0.238 ms) XLA's native TopK lowering is at
-    parity or better, so the engine uses ``lax.top_k`` — this kernel is
-    kept as the tested building block for fusing selection with
-    neighbouring stages, where XLA's top_k cannot participate. Falls back
-    to ``lax.top_k`` when k exceeds the lane width or a row block exceeds
-    the VMEM budget. Non-lane-aligned widths pay one -inf pad copy."""
+    The engine (``flat.FlatDGCEngine._exact_topk``) routes exact selection
+    through this kernel on TPU below a WORK-BASED crossover of ~2M
+    element-extractions per row block (k * cols): below it the kernel's
+    sequential extraction beats XLA's sort-based TopK (measured on v5e,
+    device profile: [22, 36864] k=37 — kernel 0.14 vs sort 0.16 ms), above
+    it the sort wins ([19, 65536] k=66 — kernel 0.52 vs sort 0.42 ms). At
+    small row counts the two are at parity ([8, 36864] k=37: 0.242 vs
+    0.238 ms), so the gate is conservative there. Independently of that
+    gate, this function self-delegates to ``lax.top_k`` when k exceeds the
+    lane width or a row block exceeds the VMEM budget. Non-lane-aligned
+    widths pay one -inf pad copy."""
     R, cols = x.shape
     # k > cols delegates so lax.top_k raises its usual error; k > _LANE
     # exceeds the [8, 128] output block; oversized rows exceed VMEM

@@ -93,6 +93,16 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
 
     per_worker_opt_state = True
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.local_axis_name is not None:
+            # without this, update_flat would run the exchange over the
+            # host axis only while the step builder shards data over both
+            # tiers — silent divergence instead of a clear error
+            raise NotImplementedError(
+                "Adasum does not compose with the two-tier hierarchical "
+                "exchange; use the default DistributedOptimizer or flat DP")
+
     def update(self, grads, opt_state, params, mem_state, key=None):
         raise NotImplementedError(
             "Adasum is implemented for the flat-engine path; use "

@@ -39,9 +39,19 @@ class DistributedOptimizer:
       optimizer: base optax-style transformation (e.g. ``dgc_sgd``).
       compressor: the compression plugin (``DGCCompressor``,
         ``NoneCompressor``, ...). Its ``memory`` handles error feedback.
-      axis_name: mesh axis over which gradients are exchanged.
-      world_size: static number of workers on that axis.
+      axis_name: mesh axis over which gradients are exchanged (the
+        host/DCN axis in two-tier mode).
+      world_size: static TOTAL number of workers (across all axes).
       fuse_payloads: concatenate sparse payloads into one exchange.
+      local_axis_name: set to enable the **two-tier hierarchical
+        exchange** (the real form of the reference's "#Sparsified Nodes <
+        #GPUs" regime, /root/reference/README.md:126-128,133-134): the
+        gradient is first dense-aggregated over this mesh axis (intra-host
+        ICI, near-free), then the sparse DGC exchange runs over
+        ``axis_name`` only (cross-host DCN) among ``world_size //
+        local_size`` sparsified nodes.
+      local_size: workers per node on ``local_axis_name``; must divide
+        ``world_size``.
     """
 
     #: True when the wrapped optimizer steps on LOCAL (pre-exchange)
@@ -51,12 +61,41 @@ class DistributedOptimizer:
 
     def __init__(self, optimizer: optax.GradientTransformation,
                  compressor: Compressor, axis_name: str = "data",
-                 world_size: int = 1, fuse_payloads: bool = True):
+                 world_size: int = 1, fuse_payloads: bool = True,
+                 local_axis_name: Optional[str] = None,
+                 local_size: int = 1):
         self.optimizer = optimizer
         self.compressor = compressor
         self.axis_name = axis_name
         self.world_size = world_size
         self.fuse_payloads = fuse_payloads
+        if local_axis_name is not None:
+            if local_size <= 1:
+                raise ValueError(
+                    "two-tier mode needs local_size > 1 (got "
+                    f"{local_size}); omit local_axis_name for flat DP")
+            if world_size % local_size:
+                raise ValueError(
+                    f"local_size {local_size} must divide world_size "
+                    f"{world_size}")
+        elif local_size > 1:
+            raise ValueError(
+                f"local_size {local_size} given without local_axis_name — "
+                "name the mesh axis for the dense (ICI) tier to enable the "
+                "two-tier exchange")
+        self.local_axis_name = local_axis_name
+        self.local_size = local_size if local_axis_name is not None else 1
+        #: number of sparse-exchange participants on ``axis_name``
+        #: (sparsified nodes in two-tier mode; all workers otherwise)
+        self.num_nodes = world_size // self.local_size
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the data batch (and per-worker state) shards over —
+        ``(axis_name,)`` flat, ``(axis_name, local_axis_name)`` two-tier."""
+        if self.local_axis_name is not None:
+            return (self.axis_name, self.local_axis_name)
+        return (self.axis_name,)
 
     # ------------------------------------------------------------------ #
 
@@ -86,7 +125,8 @@ class DistributedOptimizer:
         """Flat-path analogue of :meth:`update`: fused exchange over the [P]
         buffer, then the wrapped optimizer on the same buffer."""
         exchanged, mem_state = engine.exchange(
-            flat_grads, mem_state, key, self.axis_name, self.world_size)
+            flat_grads, mem_state, key, self.axis_name, self.num_nodes,
+            local_axis=self.local_axis_name, local_size=self.local_size)
         updates, opt_state = self.optimizer.update(exchanged, opt_state,
                                                    flat_params)
         return updates, opt_state, mem_state
@@ -99,7 +139,16 @@ class DistributedOptimizer:
 
         ``grads`` is a (nested) pytree; returns the exchanged pytree of the
         same structure plus the updated memory state.
+
+        In two-tier mode the gradients are first dense-averaged over the
+        local (ICI) axis; the compress/communicate/decompress pipeline then
+        runs among the ``num_nodes`` sparsified nodes on ``axis_name``
+        exactly as in flat DP.
         """
+        if self.local_axis_name is not None:
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, self.local_axis_name)
+                / self.local_size, grads)
         named, treedef = named_flatten(grads)
         comp = self.compressor
 
@@ -115,9 +164,9 @@ class DistributedOptimizer:
         # --- dense fallback path: psum + average (+ memory correction) ---
         for name, (payload, ctx) in dense.items():
             gathered = comp.communicate(payload, ctx, self.axis_name,
-                                        self.world_size)
+                                        self.num_nodes)
             out[name], mem_state = comp.decompress(gathered, ctx, mem_state,
-                                                   self.world_size)
+                                                   self.num_nodes)
 
         # --- sparse path --- (fusion is a compressor capability discovered
         # by duck typing, like the reference's communicate/synchronize
@@ -126,14 +175,14 @@ class DistributedOptimizer:
             fused = getattr(comp, "exchange_fused", None)
             if self.fuse_payloads and fused is not None and len(compressed) > 1:
                 fused_out, mem_state = fused(compressed, self.axis_name,
-                                             self.world_size, mem_state)
+                                             self.num_nodes, mem_state)
                 out.update(fused_out)
             else:
                 for name, (payload, ctx) in compressed.items():
                     gathered = comp.communicate(payload, ctx, self.axis_name,
-                                                self.world_size)
+                                                self.num_nodes)
                     out[name], mem_state = comp.decompress(
-                        gathered, ctx, mem_state, self.world_size)
+                        gathered, ctx, mem_state, self.num_nodes)
 
         ordered = {name: out[name] for name in named}
         return named_unflatten(ordered, treedef), mem_state

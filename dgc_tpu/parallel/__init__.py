@@ -1,8 +1,12 @@
 from dgc_tpu.parallel.mesh import (
     DATA_AXIS,
+    HOST_AXIS,
+    LOCAL_AXIS,
     data_sharding,
     make_mesh,
+    make_two_tier_mesh,
     replicated_sharding,
 )
 
-__all__ = ["DATA_AXIS", "data_sharding", "make_mesh", "replicated_sharding"]
+__all__ = ["DATA_AXIS", "HOST_AXIS", "LOCAL_AXIS", "data_sharding",
+           "make_mesh", "make_two_tier_mesh", "replicated_sharding"]

@@ -18,9 +18,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "data_sharding", "replicated_sharding", "DATA_AXIS"]
+__all__ = ["make_mesh", "make_two_tier_mesh", "data_sharding",
+           "replicated_sharding", "DATA_AXIS", "HOST_AXIS", "LOCAL_AXIS"]
 
 DATA_AXIS = "data"
+HOST_AXIS = "hosts"
+LOCAL_AXIS = "local"
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -32,6 +35,34 @@ def make_mesh(n_devices: Optional[int] = None,
         if n_devices is not None:
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_two_tier_mesh(num_hosts: int, local_size: int,
+                       devices: Optional[Sequence] = None,
+                       host_axis: str = HOST_AXIS,
+                       local_axis: str = LOCAL_AXIS) -> Mesh:
+    """2-D ``(hosts, local)`` mesh for the hierarchical two-tier exchange
+    (dense over ICI within a host, sparse DGC over DCN across hosts — the
+    real form of the reference's "#Sparsified Nodes < #GPUs" regime, which
+    it can only *simulate* via ``num_batches_per_step``,
+    /root/reference/README.md:126-128,133-134).
+
+    Devices are grouped by process so each mesh row is one host's chips:
+    collectives over ``local_axis`` then ride ICI, collectives over
+    ``host_axis`` cross DCN. On a single process the grouping is the
+    device order (rows are ICI-adjacent on one slice; on the fake CPU mesh
+    the split is purely logical).
+    """
+    if devices is None:
+        devices = sorted(jax.devices(),
+                         key=lambda d: (d.process_index, d.id))
+    need = num_hosts * local_size
+    if len(devices) < need:
+        raise ValueError(
+            f"two-tier mesh needs {num_hosts}x{local_size}={need} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(num_hosts, local_size)
+    return Mesh(grid, (host_axis, local_axis))
 
 
 def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:

@@ -81,8 +81,13 @@ def local_batch_slice(global_batch: int):
     return slice(start, start + per)
 
 
-def host_local_to_global(arr, mesh, axis: str = "data"):
+def host_local_to_global(arr, mesh, axis=None):
     """Host batch array -> global ``jax.Array`` sharded on the data axis.
+
+    ``axis`` defaults to ALL the mesh's axis names — on the 1-D data mesh
+    that is ``('data',)``, on the two-tier ``('hosts', 'local')`` mesh the
+    batch shards over both tiers (process h's devices hold the h-th
+    contiguous block, matching :func:`local_batch_slice`).
 
     Single process: a sharded device_put. Multi-process: a jit over a
     pod-spanning mesh cannot take process-local arrays — each host keeps
@@ -91,6 +96,8 @@ def host_local_to_global(arr, mesh, axis: str = "data"):
     contract of multi-host JAX; this is the harness's replacement for the
     reference's DistributedSampler, train.py:99-100)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    if axis is None:
+        axis = tuple(mesh.axis_names)
     sharding = NamedSharding(mesh, P(axis))
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)

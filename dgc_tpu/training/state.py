@@ -40,9 +40,13 @@ def with_leading_axis(tree: Any, world_size: int) -> Any:
         if hasattr(x, "shape") else x, tree)
 
 
-def state_specs(state: TrainState, axis: str = "data",
+def state_specs(state: TrainState, axis="data",
                 per_worker_opt: bool = False) -> TrainState:
     """PartitionSpec pytree for shard_map in/out_specs.
+
+    ``axis`` is a mesh-axis name or a tuple of names (the two-tier
+    ``('hosts', 'local')`` mesh): per-worker state shards its leading
+    [world] axis over all of them.
 
     ``per_worker_opt``: the Adasum delta-optimizer scheme steps the base
     optimizer on LOCAL gradients, so its state is genuinely per-worker
@@ -58,10 +62,11 @@ def state_specs(state: TrainState, axis: str = "data",
     )
 
 
-def shard_state(state: TrainState, mesh: Mesh, axis: str = "data",
+def shard_state(state: TrainState, mesh: Mesh, axis="data",
                 per_worker_opt: Optional[bool] = None,
                 dist_opt=None) -> TrainState:
-    """Place state on the mesh with the canonical shardings.
+    """Place state on the mesh with the canonical shardings. ``axis``
+    accepts a tuple of mesh-axis names for the two-tier mesh.
 
     Pass the ``DistributedOptimizer`` as ``dist_opt`` and the per-worker
     opt-state flag is derived from it (``per_worker_opt_state``, the Adasum

@@ -127,7 +127,8 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     """
     loss_fn = make_loss_fn(apply_fn)
     world = dist_opt.world_size
-    axis = dist_opt.axis_name
+    axes = dist_opt.data_axes      # (axis,) flat, (hosts, local) two-tier
+    local_size = dist_opt.local_size
     nbps = num_batches_per_step
     r_nbps = 1.0 / nbps
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -159,9 +160,21 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         memory = _squeeze0(state.memory)
         packed_stats = _squeeze0(state.batch_stats)
 
-        widx = jax.lax.axis_index(axis)
-        key = jax.random.fold_in(key, widx)
-        dropout_key, sparsify_key = jax.random.split(key)
+        if len(axes) == 1:
+            widx = jax.lax.axis_index(axes[0])
+            key = jax.random.fold_in(key, widx)
+            dropout_key, sparsify_key = jax.random.split(key)
+        else:
+            # two-tier: dropout differs per worker; the SPARSIFY key is
+            # shared within a local group — every worker of a node holds the
+            # identical node-aggregated gradient and must make the identical
+            # selection, or the replicated (P()) outputs would diverge
+            nidx = jax.lax.axis_index(axes[0])
+            widx = nidx * local_size + jax.lax.axis_index(axes[1])
+            dropout_key = jax.random.split(
+                jax.random.fold_in(key, widx))[0]
+            sparsify_key = jax.random.split(
+                jax.random.fold_in(key, world + nidx))[1]
 
         mb_images = images.reshape((nbps, -1) + images.shape[1:])
         mb_labels = labels.reshape((nbps, -1))
@@ -186,7 +199,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         new_params, opt_state, memory = do_update(
             grads, state.params, opt_state, memory, sparsify_key)
 
-        mean_loss = jax.lax.psum(loss, axis) / world
+        mean_loss = jax.lax.psum(loss, axes) / world
 
         new_state = TrainState(
             step=state.step + 1,
@@ -200,10 +213,10 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step_fn(state, images, labels, key):
-        specs = state_specs(state, axis, per_worker_opt)
+        specs = state_specs(state, axes, per_worker_opt)
         sharded = jax.shard_map(
             worker, mesh=mesh,
-            in_specs=(specs, P(axis), P(axis), P()),
+            in_specs=(specs, P(axes), P(axes), P()),
             out_specs=(specs, {"loss": P()}),
             check_vma=False)
         return sharded(state, images, labels, key)
@@ -212,12 +225,13 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
 
 def build_eval_step(apply_fn: Callable, mesh: Mesh, world_size: int,
-                    axis: str = "data", topk: Tuple[int, ...] = (1, 5),
+                    axis="data", topk: Tuple[int, ...] = (1, 5),
                     flat: Optional[FlatSetup] = None):
     """Jitted eval step: per-worker inference with local BN stats, top-k
     correct counts Sum-reduced over the mesh (reference train.py:304-328).
     With ``flat``, params/batch_stats are the flat buffers from the flat
-    train state."""
+    train state. ``axis`` accepts a tuple of mesh-axis names (two-tier
+    mesh); counts reduce over all of them."""
 
     def worker(params, batch_stats, images, labels):
         batch_stats = _squeeze0(batch_stats)

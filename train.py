@@ -118,12 +118,42 @@ def main():
     configs.train.num_batches_per_step = configs.train.get(
         "num_batches_per_step", 1)
 
-    mesh = make_mesh(args.cpu_mesh if args.cpu_mesh else None)
+    # num_local_workers > 1 selects the two-tier hierarchical exchange:
+    # dense aggregation over ICI within each group of that many workers,
+    # sparse DGC over DCN across groups — the real form of the reference's
+    # "#Sparsified Nodes < #GPUs" regime (README.md:126-128,133-134, which
+    # it simulates via num_batches_per_step). On a TPU pod set it to the
+    # per-host chip count (e.g. --train.num_local_workers 8 on v5e-8 hosts).
+    num_local = int(configs.train.get("num_local_workers", 1) or 1)
+    if num_local > 1:
+        from dgc_tpu.parallel import make_two_tier_mesh
+        n_dev = args.cpu_mesh if args.cpu_mesh else len(jax.devices())
+        if n_dev % num_local:
+            raise SystemExit(
+                f"--train.num_local_workers {num_local} must divide the "
+                f"device count {n_dev}")
+        # the local tier carries the FULL dense gradient psum every step —
+        # it must stay on ICI. A value that makes mesh rows span processes
+        # would put that psum on DCN (performance-inverted, silently).
+        if (jax.process_count() > 1
+                and jax.local_device_count() % num_local):
+            raise SystemExit(
+                f"--train.num_local_workers {num_local} must divide the "
+                f"per-process device count {jax.local_device_count()} on "
+                "multi-host runs, or the dense tier would cross hosts")
+        mesh = make_two_tier_mesh(n_dev // num_local, num_local)
+        axis = tuple(mesh.axis_names)
+    else:
+        mesh = make_mesh(args.cpu_mesh if args.cpu_mesh else None)
+        axis = mesh.axis_names[0]
     world = mesh.devices.size
-    axis = mesh.axis_names[0]
 
+    # two-tier runs get their own experiment dir: the error-feedback memory
+    # has per-NODE semantics there — resuming a flat run's per-worker
+    # residuals (same shapes!) would silently corrupt momentum correction
+    tier_tag = f".tt{num_local}" if num_local > 1 else ""
     configs.train.save_path = (get_save_path(*args.configs)
-                               + f"{args.suffix}.np{world}")
+                               + f"{args.suffix}{tier_tag}.np{world}")
     printr(f"[train.save_path] = {configs.train.save_path}")
     ckpt_dir = os.path.join(configs.train.save_path, "checkpoints")
     printr(configs)
@@ -191,8 +221,11 @@ def main():
     optimizer = configs.train.optimizer(lr=lr_schedule,
                                         weight_decay_mask=wd_mask)
 
-    dist = DistributedOptimizer(optimizer, compression, axis_name=axis,
-                                world_size=world)
+    dist = DistributedOptimizer(
+        optimizer, compression, axis_name=mesh.axis_names[0],
+        world_size=world,
+        local_axis_name=mesh.axis_names[1] if num_local > 1 else None,
+        local_size=num_local)
 
     flat_setup = make_flat_setup(variables, dist)
     state = shard_state(make_flat_state(variables, dist, flat_setup, world),
