@@ -128,9 +128,36 @@ def main():
     state3, m3 = step_fn(r_state, images, labels, jax.random.PRNGKey(5))
     assert np.isfinite(float(m3["loss"]))
 
+    # --- two-tier hierarchical exchange across the REAL process boundary:
+    # each process is one "host" row (its 4 local devices form the dense
+    # tier); the sparse DGC gather crosses the gRPC/DCN link only ---
+    from dgc_tpu.parallel import make_two_tier_mesh
+    mesh_tt = make_two_tier_mesh(num_procs, W // num_procs)
+    assert [d.process_index for d in mesh_tt.devices[proc_id]] == \
+        [proc_id] * (W // num_procs), "mesh rows must align with processes"
+    comp_tt = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9))
+    comp_tt.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist_tt = DistributedOptimizer(
+        dgc_sgd(0.1, momentum=0.9), comp_tt, axis_name="hosts",
+        world_size=W, local_axis_name="local", local_size=W // num_procs)
+    setup_tt = make_flat_setup(v, dist_tt)
+    state_tt = shard_state(make_flat_state(v, dist_tt, setup_tt, W),
+                           mesh_tt, dist_tt.data_axes, dist_opt=dist_tt)
+    step_tt = build_train_step(apply_fn, dist_tt, mesh_tt, donate=False,
+                               flat=setup_tt)
+    images_tt = host_local_to_global(images_h, mesh_tt)
+    labels_tt = host_local_to_global(labels_h, mesh_tt)
+    tt_losses = []
+    for i in range(2):
+        state_tt, m = step_tt(state_tt, images_tt, labels_tt,
+                              jax.random.PRNGKey(i))
+        tt_losses.append(float(m["loss"]))
+    assert all(np.isfinite(tl) for tl in tt_losses)
+
     print("RESULT:" + json.dumps({
         "proc": proc_id,
         "losses": losses,
+        "tt_losses": tt_losses,
         "resume_loss": float(m3["loss"]),
         "coordinator": is_coordinator(),
     }), flush=True)

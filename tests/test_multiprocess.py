@@ -54,6 +54,11 @@ def test_two_process_train_save_resume(tmp_path):
     assert set(results) == {0, 1}
     # single-controller semantics: both processes observe identical losses
     assert results[0]["losses"] == results[1]["losses"]
+    # two-tier hierarchical exchange over the real process boundary agrees
+    assert results[0]["tt_losses"] == results[1]["tt_losses"]
+    # first-step losses match: before any exchange reaches the params, the
+    # two runs share params and data, so forward losses are near-identical
+    assert abs(results[0]["losses"][0] - results[0]["tt_losses"][0]) < 1e-4
     assert results[0]["coordinator"] and not results[1]["coordinator"]
     # coordinator-only file bookkeeping
     assert (tmp_path / "logs" / "metrics.jsonl").exists()
