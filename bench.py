@@ -93,8 +93,14 @@ def _make_k_loop(step_fn, images, labels, k):
     """K train steps inside ONE jitted lax.scan: a single dispatch drives K
     device iterations, so the relay's per-call dispatch latency (which in
     slow phases exceeds the step's device time) cannot contaminate the
-    measurement."""
-    @jax.jit
+    measurement. The carried train state is donated — without donation the
+    scan inserts per-iteration carry copies (measured ~1 ms/step of
+    'data formatting'/dynamic-update-slice ops attributed to this line in
+    the device profile) that per-dispatch training with donation never
+    pays, inflating the DGC side (bigger carry) more than the dense side."""
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def k_loop(state, key):
         def body(s, ki):
             s2, m = step_fn(s, images, labels, ki)

@@ -33,6 +33,7 @@ wire volume matches the reference's exactly), scatter-add-then-average
 decompress, momentum correction and masking per SURVEY.md §2.3-2.5.
 """
 
+import math
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -142,16 +143,12 @@ class ParamLayout:
             off += self.sizes[n]
         self.p_data_end = off
         self.total = _round_up(off, _ALIGN) if off else 0
-        # Wire indices are int32 (the reference's int32_indices flag is
-        # always-on here — dgc.py __init__); a flat buffer at or above 2**31
-        # elements (~8 GiB fp32 of parameters) would overflow them. The
-        # BASELINE "int64 idx" config row anticipates this scale — reaching
-        # it needs an int64 index wire format, not a silent wrap.
-        if self.total >= 2 ** 31:
-            raise ValueError(
-                f"flat layout has {self.total} slots >= 2**31: int32 wire "
-                "indices would overflow (BASELINE 'int64 idx' row); shard "
-                "the model or add an int64 index path")
+        #: minimal index dtype the flat offsets fit in: int32 normally,
+        #: int64 at/above 2**31 slots (~8 GiB fp32 of parameters — the
+        #: BASELINE "int64 idx" config row). The engine forces the int64
+        #: wire format there (FlatDGCEngine.index_dtype) instead of
+        #: silently wrapping; int64 device arrays need jax x64 mode.
+        self.index_dtype = np.int32 if self.total < 2 ** 31 else np.int64
         # insertion order of `named` (the treedef leaf order), for unflatten
         self._tree_order = list(named)
 
@@ -275,48 +272,115 @@ class _Bucket(NamedTuple):
     stride_groups: Tuple[Tuple[int, int, int, int], ...]
 
 
-def _build_buckets(attributes, layout: ParamLayout) -> List[_Bucket]:
+#: single-tensor bucket rows wider than this are split into S segments
+#: (stratified selection): approx top-k over ONE giant row has no row
+#: parallelism and its k grows with the tensor — VGG-16's fc1
+#: ([1, 102.8M], k=102761) measured 19.6 ms PartialReduce + 17.2 ms
+#: aggregation sort per step on v5e (device profile). Split into
+#: ~4M-wide segments with the per-tensor quota distributed EXACTLY
+#: (payload/wire volume unchanged), each segment estimating its own
+#: sampled threshold — selection becomes "threshold passers, capped per
+#: segment", the stratified analogue of the reference's index-order
+#: truncation (compression.py:151); misses stay in error feedback.
+_SPLIT_COLS = 8 * 1024 * 1024
+_SPLIT_TARGET = 4 * 1024 * 1024
+
+
+def _segment_rows(name, attrs, base, cols, sample_ratio, compress_ratio):
+    """Split one giant tensor row into S segment rows: returns
+    (seg_cols, list of per-segment TensorAttrs-like tuples
+    (row_off, numel, stride, num_samples, topk_samples, num_selects))."""
+    from dgc_tpu.compression.dgc import sampling_geometry
+    S = 1
+    while (cols % (2 * S) == 0 and cols // (2 * S) >= _SPLIT_TARGET
+           and attrs.num_selects >= 2 * S):
+        S *= 2
+    seg_cols = cols // S
+    rows = []
+    rem_sel = attrs.num_selects
+    rem_numel = attrs.numel
+    for s in range(S):
+        numel_s = min(seg_cols, attrs.numel - s * seg_cols)
+        assert numel_s > 0, (name, s, seg_cols, attrs.numel)
+        # proportional quota with exact total (largest-remainder on the
+        # running remainder keeps sum == num_selects)
+        ns = (rem_sel if s == S - 1
+              else int(round(rem_sel * numel_s / rem_numel)))
+        ns = max(1, min(ns, rem_sel - (S - 1 - s)))
+        rem_sel -= ns
+        rem_numel -= numel_s
+        num_samples, stride = sampling_geometry(numel_s, sample_ratio,
+                                                compress_ratio)
+        topk = max(1, int(math.ceil(num_samples * compress_ratio)))
+        rows.append((base + s * seg_cols, numel_s, stride, num_samples,
+                     topk, ns))
+    return seg_cols, rows
+
+
+def _build_buckets(attributes, layout: ParamLayout,
+                   compressor=None) -> List[_Bucket]:
     """Per-ratio sparsification attributes for each of the layout's size
     buckets (the geometry itself is ratio-independent, layout.buckets)."""
     buckets: List[_Bucket] = []
     for g in layout.buckets:
-        attrs = [attributes[n] for n in g.names]
-        num_selects = np.array([a.num_selects for a in attrs], np.int32)
-        max_sel = int(num_selects.max())
-        tight = np.concatenate([
-            np.arange(r * max_sel, r * max_sel + k, dtype=np.int64)
-            for r, k in enumerate(num_selects)])
-        strides_np = np.array([a.sample_stride for a in attrs], np.int32)
-        samples_np = np.array([a.num_samples for a in attrs], np.int32)
-        stride_groups = []
-        r0 = 0
-        for r in range(1, g.rows + 1):
-            if r == g.rows or strides_np[r] != strides_np[r0]:
-                stride_groups.append((r0, r, int(strides_np[r0]),
-                                      int(samples_np[r0:r].max())))
-                r0 = r
-        buckets.append(_Bucket(
-            base=g.base,
-            rows=g.rows,
-            cols=g.cols,
-            row_offsets=np.array([layout.offsets[n] for n in g.names],
-                                 np.int32),
-            numels=np.array([a.numel for a in attrs], np.int32),
-            strides=np.array([a.sample_stride for a in attrs], np.int32),
-            num_samples=np.array([a.num_samples for a in attrs], np.int32),
-            max_s=int(max(a.num_samples for a in attrs)),
-            topk_samples=np.array([a.top_k_samples for a in attrs],
-                                  np.int32),
-            max_k=int(max(a.top_k_samples for a in attrs)),
-            num_selects=num_selects,
-            max_sel=max_sel,
-            adapt=np.array([a.numel > a.num_samples for a in attrs], bool),
-            exact=all(a.num_samples >= a.numel for a in attrs),
-            tight=tight,
-            payload=int(num_selects.sum()),
-            stride_groups=tuple(stride_groups),
-        ))
+        if (compressor is not None and len(g.names) == 1
+                and g.cols > _SPLIT_COLS
+                and attributes[g.names[0]].num_selects >= 2):
+            name = g.names[0]
+            seg_cols, rows = _segment_rows(
+                name, attributes[name], g.base, g.cols,
+                compressor.sample_ratio, compressor.compress_ratio)
+            if len(rows) > 1:
+                buckets.append(_bucket_from_rows(g.base, seg_cols, rows))
+                continue
+        rows = [(layout.offsets[n], a.numel, a.sample_stride,
+                 a.num_samples, a.top_k_samples, a.num_selects)
+                for n, a in ((n, attributes[n]) for n in g.names)]
+        buckets.append(_bucket_from_rows(g.base, g.cols, rows))
     return buckets
+
+
+def _bucket_from_rows(base: int, cols: int, rows) -> _Bucket:
+    """Assemble a :class:`_Bucket` from per-row tuples
+    ``(row_off, numel, stride, num_samples, topk_samples, num_selects)``."""
+    cols_in = list(zip(*rows))
+    # offsets can exceed int32 at the int64-wire scale; the rest are
+    # tensor-local and always fit
+    offs = np.array(cols_in[0], np.int64)
+    numels, strides, samples, topks, selects = (
+        np.array(c, np.int32) for c in cols_in[1:])
+    num_selects = selects
+    max_sel = int(num_selects.max())
+    tight = np.concatenate([
+        np.arange(r * max_sel, r * max_sel + k, dtype=np.int64)
+        for r, k in enumerate(num_selects)])
+    stride_groups = []
+    n_rows = len(rows)
+    r0 = 0
+    for r in range(1, n_rows + 1):
+        if r == n_rows or strides[r] != strides[r0]:
+            stride_groups.append((r0, r, int(strides[r0]),
+                                  int(samples[r0:r].max())))
+            r0 = r
+    return _Bucket(
+        base=base,
+        rows=n_rows,
+        cols=cols,
+        row_offsets=offs,
+        numels=numels,
+        strides=strides,
+        num_samples=samples,
+        max_s=int(samples.max()),
+        topk_samples=topks,
+        max_k=int(topks.max()),
+        num_selects=num_selects,
+        max_sel=max_sel,
+        adapt=numels > samples,
+        exact=bool((samples >= numels).all()),
+        tight=tight,
+        payload=int(num_selects.sum()),
+        stride_groups=tuple(stride_groups),
+    )
 
 
 def _exact_topk(x: jax.Array, k: int):
@@ -345,19 +409,56 @@ def _ladder_adapt(imp_rows, thr, num_selects, adapt_mask, lower,
     "first i with count >= lo, else max_iters" is a closed-form pick once
     all ladder counts are known — computed in ONE pass over the rows
     (Pallas kernel on TPU; its jnp reference elsewhere) instead of one full
-    re-scan per loop iteration."""
+    re-scan per loop iteration.
+
+    The engine's hot path no longer calls this (it derives the identical
+    ladder choice from the selection top-k, :func:`_ladder_adapt_from_topk`
+    — zero extra HBM passes); kept as the full-scan reference the
+    equivalence test pins the derivation against."""
     levels = max_iters + 1
     if kernels.use_pallas():
         counts = kernels.ladder_counts(imp_rows, thr, lower, levels)
     else:
         counts = kernels.ladder_counts_reference(imp_rows, thr, lower,
                                                  levels)
+    return _ladder_pick(counts, thr, num_selects, adapt_mask, lower,
+                        max_iters)
+
+
+def _ladder_pick(counts, thr, num_selects, adapt_mask, lower,
+                 max_iters: int):
+    """Closed-form ladder stopping rule from per-level pass counts:
+    first i with count >= lower * num_selects, else max_iters."""
     lo = (lower * num_selects)[:, None]                   # [R, 1]
     passing = counts.astype(jnp.float32) >= lo            # [R, L]
     first = jnp.argmax(passing, axis=1).astype(jnp.int32)
     i_star = jnp.where(jnp.any(passing, axis=1), first, max_iters)
     adapted = thr * (lower ** i_star.astype(thr.dtype))
     return jnp.where(adapt_mask, adapted, thr)
+
+
+def _ladder_adapt_from_topk(top_scores, thr, num_selects, adapt_mask,
+                            lower, max_iters: int):
+    """Ladder adaptation with ZERO extra HBM passes: the per-level counts
+    are derived from the (sorted) selection top-k values instead of
+    re-scanning the [R, cols] importance block.
+
+    Why this is exact (equal to :func:`_ladder_adapt` on the same
+    selection): for any level t, if the true count ``#{imp >= t}`` is at
+    most k, every such element is inside the top-k, so the count computed
+    over ``top_scores`` equals it; if the true count exceeds k, the top-k
+    count saturates at k — but the stopping rule only asks ``count >=
+    lower * num_selects`` and ``lower * num_selects <= num_selects <= k``,
+    so a saturated count passes exactly when the true count does. Hence
+    the chosen level i* is identical. (With approximate selection the
+    top-k itself is approximate; the derived counts inherit exactly the
+    selection's recall, nothing more — and on CPU, where approx_max_k
+    lowers to an exact sort, the equality is bitwise.)"""
+    levels = max_iters + 1
+    t = thr[:, None] * (lower ** jnp.arange(levels, dtype=thr.dtype))[None]
+    counts = jnp.sum(top_scores[:, :, None] >= t[:, None, :], axis=1)
+    return _ladder_pick(counts, thr, num_selects, adapt_mask, lower,
+                        max_iters)
 
 
 def _batched_adapt(imp_rows, thr, num_selects, adapt_mask, lower, upper,
@@ -399,9 +500,24 @@ class FlatDGCEngine:
         self.c = compressor
         self.layout = layout
         self.T = layout.t_compressed
+        # wire index dtype: int32 unless the flat offsets cannot fit
+        # (layout.total >= 2**31, the BASELINE "int64 idx" row) or the
+        # config explicitly asks for the int64 wire format
+        # (int32_indices=False, reference compression.py:26 semantics)
+        want64 = (not getattr(compressor, "int32_indices", True)
+                  or np.dtype(layout.index_dtype) == np.int64)
+        if want64 and not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "the int64 index wire format needs jax x64 mode: enable "
+                "jax_enable_x64 (JAX_ENABLE_X64=1 or "
+                "jax.experimental.enable_x64()) — required because "
+                f"int32_indices={getattr(compressor, 'int32_indices', True)}"
+                f" and the flat layout holds {layout.total} slots")
+        self.index_dtype = jnp.int64 if want64 else jnp.int32
         # ratio >= 1.0 transmits everything dense (per-tensor path's
         # `compress_ratio < 1.0` guard) — no buckets, no sparse payload
-        self.buckets = (_build_buckets(compressor.attributes, layout)
+        self.buckets = (_build_buckets(compressor.attributes, layout,
+                                       compressor)
                         if compressor.compress_ratio < 1.0 else [])
         #: per-worker wire payload in elements — matches the reference's
         #: sum of per-tensor num_selects exactly (compression.py:151)
@@ -432,8 +548,10 @@ class FlatDGCEngine:
         T, P = self.T, self.layout.total
         zc = jnp.zeros((T,), self.layout.dtype)
         zd = jnp.zeros((P - T,), self.layout.dtype)
-        # masking is DEFERRED: the step that transmits records its keep
-        # mask (0.0 at transmitted coords); the NEXT step's compensate
+        # masking is DEFERRED: the step that transmits records its
+        # transmit COUNTS (sent_c, >0 at transmitted coords — the count
+        # rides the decompress scatter-add as one fused [2T] scatter, so
+        # the record costs no extra scatter); the NEXT step's compensate
         # applies the zeroing on read, fused into the Pallas kernel
         # (kernels.fused_compensate_masked) — bitwise identical to eager
         # masking but it rides the compensate pass instead of costing its
@@ -442,28 +560,29 @@ class FlatDGCEngine:
         # checkpoints survive warm-up ratio changes. f32 deliberately: a
         # sub-word (int8) mask would quarter the read bandwidth but its
         # SCATTER lowers to a serial while-loop on v5e (~2.3 ms/step
-        # measured); the f32 scatter-into-fresh-ones is the fast path.
+        # measured).
         return {"momentums_c": zc, "velocities_c": zc,
                 "momentums_d": zd, "velocities_d": zd,
-                "keep_c": jnp.ones((T,), self.layout.dtype)}
+                "sent_c": jnp.zeros((T,), self.layout.dtype)}
 
-    def _compensate_acc(self, mmt, vec, grad, keep=None):
+    def _compensate_acc(self, mmt, vec, grad, sent=None):
         """Momentum correction + local accumulation (memory.py:50-63) —
         the fused single-pass Pallas kernel on TPU, its jnp reference
-        elsewhere (bit-compatible, tests/test_kernels.py). With ``keep``,
-        the previous step's transmit mask (memory.py:72-77) is applied on
-        read inside the same pass (deferred masking)."""
+        elsewhere (bit-compatible, tests/test_kernels.py). With ``sent``
+        (the previous step's transmit counts, 0 = keep), the transmit mask
+        (memory.py:72-77) is applied on read inside the same pass
+        (deferred masking)."""
         m = self._mem
         if m is None:
             return grad, mmt, vec
-        if keep is not None:
+        if sent is not None:
             if kernels.use_pallas() and grad.shape[0] > 0:
                 mmt, vec = kernels.fused_compensate_masked(
-                    grad, mmt, vec, keep, m.momentum, m.nesterov,
+                    grad, mmt, vec, sent, m.momentum, m.nesterov,
                     m.momentum_masking)
             else:
                 mmt, vec = kernels.fused_compensate_masked_reference(
-                    grad, mmt, vec, keep, m.momentum, m.nesterov,
+                    grad, mmt, vec, sent, m.momentum, m.nesterov,
                     m.momentum_masking)
         elif kernels.use_pallas() and grad.shape[0] > 0:
             mmt, vec = kernels.fused_compensate(grad, mmt, vec, m.momentum,
@@ -555,19 +674,132 @@ class FlatDGCEngine:
         CPU approx_max_k lowers to an exact sort, so the flat-vs-per-tensor
         equivalence tests see identical selections."""
         r = self.c.approx_recall
-        if r is not None and max_sel > 128:
-            # the AGGREGATED form, deliberately: splitting into
-            # aggregate_to_topk=False + a manual lax.top_k over the
-            # candidates looked faster in isolated micro-benches but those
-            # were DCE-corrupted — the honest paired full-step A/B at
-            # ResNet-50 measures the aggregated form ~0.55 ms/step FASTER
-            # than the split on v5e. On CPU it also lowers to an exact
-            # sort, which the flat-vs-per-tensor equivalence suite relies
-            # on (no-aggregate would force the partial-reduce op there and
-            # lose recall).
+        # approx whenever allowed AND the exact path would pay the
+        # sort-based TopK: k beyond the lane width, or above the Pallas
+        # iterative-max kernel's work crossover (~2M element-extractions,
+        # see _exact_topk). Below both, exact selection is cheaper than the
+        # reduction anyway. Measured at the ResNet-50 [11, 65536] k=66
+        # bucket (previously routed to the sort by the old max_sel > 128
+        # gate): approx 0.048 vs sort 0.235 ms isolated on v5e.
+        if r is not None and (max_sel > 128
+                              or max_sel * scores.shape[1] > 2_000_000):
+            # the AGGREGATED single-stage form, deliberately — both
+            # restructurings lost their paired full-step A/B at ResNet-50
+            # on v5e (isolated micro-benches said otherwise both times;
+            # only paired interleaved full steps are trusted on this
+            # backend): round 2's "no-aggregate + manual lax.top_k" was
+            # ~0.55 ms/step slower, and round 3's two-stage
+            # (approx-of-candidates instead of the aggregation sort) was
+            # ~0.2 ms/step slower despite an isolated 1.5 ms win. The
+            # recall TARGET is the actual lever: 0.90 halves the candidate
+            # count the aggregation sorts vs 0.95 (-0.62 ms/step paired at
+            # ResNet-50) while measured recall stays 0.966-0.975 at every
+            # ResNet-50 bucket (scripts/measure_recall.py) — above the
+            # 0.95 regression threshold. On CPU approx_max_k lowers to an
+            # exact sort, which the flat-vs-per-tensor equivalence suite
+            # relies on.
             return jax.lax.approx_max_k(scores, max_sel,
                                         recall_target=float(r))
         return _exact_topk(scores, max_sel)
+
+    def _sample_rows(self, b: "_Bucket", imp_rows: jax.Array,
+                     k: jax.Array) -> jax.Array:
+        """Per-row threshold samples for one bucket (reference
+        compression.py:113-121); pad slots carry importance -1.
+
+        TPU-native strided sampling: sample 128-LANE BLOCKS at the
+        tensor's sampling rate instead of single elements at the
+        reference's element stride. Element-strided extraction fights the
+        [8, 128] tiling no matter how it is phrased — positional gather
+        1.5 ms, strided dynamic_slice 1.8 ms, one-hot einsum ~3 ms per
+        big ResNet-50 bucket on v5e (the [n, stride] reshape is a
+        physical relayout) — while whole-lane blocks at a block stride
+        read contiguous 512 B bursts: measured ~0.1 ms. Per tensor this
+        is still a systematic sample of the same fraction of |grad| with
+        a fresh uniform random phase per step; within-block correlation
+        slightly widens the threshold estimator's variance, which the
+        bounded ladder adaptation (compression.py:128-149) exists to
+        correct — bounded empirically by
+        tests/test_flat.py::test_lane_block_sampling_quantile. The
+        contract requires sampling to match in distribution, not
+        positions (SURVEY.md §4); rows run one shared phase per stride
+        run so the extraction is ONE slice. Stride-1 runs
+        (sample-everything rows) stay exact."""
+        R = b.rows
+        numels = jnp.asarray(b.numels)[:, None]
+        neg1 = jnp.full((), -1.0, imp_rows.dtype)
+        if self.c.strided_sample:
+            L = 128
+            # widths per stride group: nb is rounded UP (truncation would
+            # draw as little as half the budget, n=255 -> 128); the
+            # overshoot (< L extra samples) biases the quantile estimate
+            # slightly HIGH, which the ladder adaptation lowers — the
+            # safe direction. Safe to read: nb*L <= round_up(n, L) <=
+            # round_up(max numel, lane) <= cols, and over-reads past a
+            # shorter row's numel land on the -1 importance pad.
+            widths = []
+            for (_, _, stride, n) in b.stride_groups:
+                widths.append(n if (stride == 1 or n < L)
+                              else -(-n // L) * L)
+            width = max(widths)
+            parts = []
+            for gi, (r0, r1, stride, n) in enumerate(b.stride_groups):
+                kg = jax.random.fold_in(k, gi)
+                u = jax.random.uniform(kg, ())
+                Rg = r1 - r0
+                nb = -(-n // L)
+                if stride == 1:
+                    # the reference's exact sample-everything path
+                    smp = imp_rows[r0:r1, :n]
+                elif n < L:
+                    # sample sets smaller than a lane block (tiny tensors
+                    # only): keep the reference's element stride with a
+                    # fresh random phase — the gather is n < 128
+                    # elements, off the sizing path
+                    phase = jnp.floor(u * stride).astype(jnp.int32)
+                    pos = phase + jnp.arange(n, dtype=jnp.int32) * stride
+                    pos = jnp.minimum(pos, b.cols - 1)
+                    smp = jnp.take_along_axis(
+                        imp_rows[r0:r1],
+                        jnp.broadcast_to(pos[None, :], (Rg, n)), axis=1)
+                else:
+                    # nb blocks at block-stride sb spread over the data
+                    # span n*stride (~ the largest row's numel)
+                    sb = max(1, (n * stride) // (nb * L))
+                    phase = jnp.floor(u * sb).astype(jnp.int32)
+                    v = imp_rows[r0:r1, :nb * sb * L].reshape(
+                        Rg, nb, sb, L)
+                    smp = jax.lax.dynamic_slice(
+                        v, (jnp.int32(0), jnp.int32(0), phase,
+                            jnp.int32(0)),
+                        (Rg, nb, 1, L)).reshape(Rg, nb * L)
+                if smp.shape[1] < width:
+                    smp = jnp.concatenate(
+                        [smp, jnp.full((Rg, width - smp.shape[1]), neg1)],
+                        axis=1)
+                parts.append(smp)
+            # no per-slot validity mask: lane-block slots do not map to
+            # the reference's slot order; out-of-row positions already
+            # read the -1 importance pad and sort below every threshold
+            return (jnp.concatenate(parts) if len(parts) > 1
+                    else parts[0])
+        s_idx = jnp.arange(b.max_s, dtype=jnp.int32)[None, :]
+        s_valid = s_idx < jnp.asarray(b.num_samples)[:, None]
+        u = jax.random.uniform(k, (R, b.max_s))
+        pos = jnp.floor(u * numels).astype(jnp.int32)
+        # rows sampling everything must sample exactly, not with
+        # replacement (per-tensor path's numel==num_samples branch,
+        # dgc.py sparsify)
+        exact = jnp.asarray(b.num_samples)[:, None] >= numels
+        pos = jnp.where(exact, jnp.minimum(s_idx, numels - 1), pos)
+        # positions are < numel <= cols by the sampling geometry
+        # (reference compression.py:66-85), so the row-local gather
+        # stays in bounds; invalid sample slots read -1
+        return jnp.where(
+            s_valid,
+            jnp.take_along_axis(imp_rows, jnp.minimum(pos, b.cols - 1),
+                                axis=1),
+            neg1)                                     # [R, maxS]
 
     def sparsify(self, vec_c: jax.Array, key: jax.Array):
         """Sampled-top-k selection over the compressed block [T].
@@ -586,12 +818,14 @@ class FlatDGCEngine:
         lay = self.layout
         S = lay.sentinel
         if not self.buckets:
-            return (jnp.zeros((0,), vec_c.dtype), jnp.zeros((0,), jnp.int32))
+            return (jnp.zeros((0,), vec_c.dtype),
+                    jnp.zeros((0,), self.index_dtype))
         out_v, out_i = [], []
         for bi, b in enumerate(self.buckets):
             k = jax.random.fold_in(key, bi)
             R = b.rows
-            row_off = jnp.asarray(b.row_offsets)[:, None]
+            row_off = jnp.asarray(b.row_offsets,
+                                  dtype=self.index_dtype)[:, None]
             numels = jnp.asarray(b.numels)[:, None]
 
             # --- batched row view: a reshape, not a gather; row tails
@@ -616,7 +850,9 @@ class FlatDGCEngine:
                 slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
                 valid = (top_scores >= 0) & (
                     slot < jnp.asarray(b.num_selects)[:, None])
-                gidx = jnp.where(valid, row_off + cols.astype(jnp.int32), S)
+                gidx = jnp.where(valid,
+                             row_off + cols.astype(self.index_dtype),
+                             jnp.asarray(S, self.index_dtype))
                 vals = jnp.where(valid,
                                  jnp.take_along_axis(block, cols, axis=1),
                                  jnp.zeros((), vec_c.dtype))
@@ -626,99 +862,47 @@ class FlatDGCEngine:
                 continue
 
             # --- sampling positions (reference compression.py:113-121) ---
-            neg1 = jnp.full((), -1.0, vec_c.dtype)
-            if self.c.strided_sample:
-                # TPU-native strided sampling: sample 128-LANE BLOCKS at
-                # the tensor's sampling rate instead of single elements at
-                # the reference's element stride (compression.py:113-118).
-                # Element-strided extraction fights the [8, 128] tiling no
-                # matter how it is phrased — positional gather 1.5 ms,
-                # strided dynamic_slice 1.8 ms, one-hot einsum ~3 ms per
-                # big ResNet-50 bucket on v5e (the [n, stride] reshape is a
-                # physical relayout) — while whole-lane blocks at a block
-                # stride read contiguous 512 B bursts: measured ~0.1 ms.
-                # Per tensor this is still a systematic sample of the same
-                # fraction of |grad| with a fresh uniform random phase per
-                # step; within-block correlation slightly widens the
-                # threshold estimator's variance, which the bounded ladder
-                # adaptation (compression.py:128-149) exists to correct.
-                # The contract requires sampling to match in distribution,
-                # not positions (SURVEY.md §4); rows run one shared phase
-                # per stride run so the extraction is ONE slice. Stride-1
-                # runs (sample-everything rows) stay exact.
-                L = 128
-                parts = []
-                for gi, (r0, r1, stride, n) in enumerate(b.stride_groups):
-                    kg = jax.random.fold_in(k, gi)
-                    u = jax.random.uniform(kg, ())
-                    Rg = r1 - r0
-                    nb = n // L
-                    if stride == 1:
-                        # the reference's exact sample-everything path
-                        smp = imp_rows[r0:r1, :n]
-                    elif nb == 0:
-                        # sample sets smaller than a lane block (tiny
-                        # tensors only): keep the reference's element
-                        # stride with a fresh random phase — the gather is
-                        # n < 128 elements, off the sizing path
-                        phase = jnp.floor(u * stride).astype(jnp.int32)
-                        pos = phase + jnp.arange(n, dtype=jnp.int32) * stride
-                        pos = jnp.minimum(pos, b.cols - 1)
-                        smp = jnp.take_along_axis(
-                            imp_rows[r0:r1],
-                            jnp.broadcast_to(pos[None, :], (Rg, n)), axis=1)
-                    else:
-                        # nb blocks at block-stride sb spread over the data
-                        # span n*stride (~ the largest row's numel)
-                        sb = max(1, (n * stride) // (nb * L))
-                        phase = jnp.floor(u * sb).astype(jnp.int32)
-                        v = imp_rows[r0:r1, :nb * sb * L].reshape(
-                            Rg, nb, sb, L)
-                        smp = jax.lax.dynamic_slice(
-                            v, (jnp.int32(0), jnp.int32(0), phase,
-                                jnp.int32(0)),
-                            (Rg, nb, 1, L)).reshape(Rg, nb * L)
-                    if smp.shape[1] < b.max_s:
-                        smp = jnp.concatenate(
-                            [smp, jnp.full((Rg, b.max_s - smp.shape[1]),
-                                           neg1)], axis=1)
-                    parts.append(smp)
-                samples = (jnp.concatenate(parts) if len(parts) > 1
-                           else parts[0])
-                # no per-slot validity mask: lane-block slots do not map to
-                # the reference's slot order; out-of-row positions already
-                # read the -1 importance pad and sort below every threshold
-            else:
-                s_idx = jnp.arange(b.max_s, dtype=jnp.int32)[None, :]
-                s_valid = s_idx < jnp.asarray(b.num_samples)[:, None]
-                u = jax.random.uniform(k, (R, b.max_s))
-                pos = jnp.floor(u * numels).astype(jnp.int32)
-                # rows sampling everything must sample exactly, not with
-                # replacement (per-tensor path's numel==num_samples branch,
-                # dgc.py sparsify)
-                exact = jnp.asarray(b.num_samples)[:, None] >= numels
-                pos = jnp.where(exact, jnp.minimum(s_idx, numels - 1), pos)
-                # positions are < numel <= cols by the sampling geometry
-                # (reference compression.py:66-85), so the row-local gather
-                # stays in bounds; invalid sample slots read -1
-                samples = jnp.where(
-                    s_valid,
-                    jnp.take_along_axis(imp_rows,
-                                        jnp.minimum(pos, b.cols - 1),
-                                        axis=1),
-                    neg1)                                     # [R, maxS]
+            samples = self._sample_rows(b, imp_rows, k)
 
             # --- per-row sampled threshold (compression.py:123) ---
-            sorted_s = _exact_topk(samples, b.max_k)[0]
+            # the threshold is a QUANTILE ESTIMATE over an already-random
+            # sample; at VGG-scale rows (fc1: max_k=1060 over a [1, 1.06M]
+            # sample set) the exact sort-based top_k here cost ~60 ms/step
+            # on v5e (118% overhead, paired) — approx_max_k estimates the
+            # same quantile, its small low-bias is exactly what the
+            # bounded ladder adaptation corrects, and on CPU it lowers to
+            # the exact sort (equivalence tests unchanged)
+            r = self.c.approx_recall
+            if r is not None and (b.max_k > 128
+                                  or b.max_k * b.max_s > 2_000_000):
+                sorted_s = jax.lax.approx_max_k(samples, b.max_k,
+                                                recall_target=float(r))[0]
+            else:
+                sorted_s = _exact_topk(samples, b.max_k)[0]
             thr = jnp.take_along_axis(
                 sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
                 axis=1)[:, 0]
 
+            # --- fixed-size selection (ops.select_by_threshold semantics) ---
+            # top-k over RAW importance, below-threshold slots invalidated
+            # after the fact: the selected set above thr is identical to
+            # top-k over threshold-masked scores (top-k orders by value, so
+            # the >= thr prefix matches), and skipping the mask saves a
+            # full [R, cols] materialization per bucket; row-tail pads
+            # carry importance -1 < 0 <= thr and can never turn valid.
+            # Selection runs BEFORE threshold adaptation (it does not
+            # depend on thr), so the resample ladder can be derived from
+            # the top-k values with no extra pass over the block.
+            top_scores, cols = self._select_topk(imp_rows, b.max_sel)
+
             # --- bounded threshold adaptation (compression.py:128-149) ---
             if self.c.max_adaptation_iters > 0 and b.adapt.any():
                 if self.c.resample:
-                    thr = _ladder_adapt(
-                        imp_rows, thr,
+                    # exact ladder choice from the selection's own top-k —
+                    # replaces the full [R, cols] ladder-counts scan (see
+                    # _ladder_adapt_from_topk for the equality argument)
+                    thr = _ladder_adapt_from_topk(
+                        top_scores, thr,
                         jnp.asarray(b.num_selects, jnp.float32),
                         jnp.asarray(b.adapt), self.c.compress_lower_bound,
                         self.c.max_adaptation_iters)
@@ -729,19 +913,12 @@ class FlatDGCEngine:
                         jnp.asarray(b.adapt), self.c.compress_lower_bound,
                         self.c.compress_upper_bound,
                         self.c.max_adaptation_iters, self.c.resample)
-
-            # --- fixed-size selection (ops.select_by_threshold semantics) ---
-            # top-k over RAW importance, below-threshold slots invalidated
-            # after the fact: the selected set above thr is identical to
-            # top-k over threshold-masked scores (top-k orders by value, so
-            # the >= thr prefix matches), and skipping the mask saves a
-            # full [R, cols] materialization per bucket; row-tail pads
-            # carry importance -1 < 0 <= thr and can never turn valid
-            top_scores, cols = self._select_topk(imp_rows, b.max_sel)
             slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
             valid = (top_scores >= thr[:, None]) & (
                 slot < jnp.asarray(b.num_selects)[:, None])
-            gidx = jnp.where(valid, row_off + cols.astype(jnp.int32), S)
+            gidx = jnp.where(valid,
+                             row_off + cols.astype(self.index_dtype),
+                             jnp.asarray(S, self.index_dtype))
             # values via a row-local gather from the reshape view (no
             # global gather); invalid slots carry 0.0 like the sentinel
             vals = jnp.where(valid, jnp.take_along_axis(block, cols, axis=1),
@@ -829,8 +1006,9 @@ class FlatDGCEngine:
             # memory.py:72-77), and reset it — carrying it forward would
             # wrongly zero the dense momentum written below
             mc, vc = mem["momentums_c"], mem["velocities_c"]
-            keep = mem.get("keep_c")
-            if m is not None and T and keep is not None:
+            sent = mem.get("sent_c")
+            if m is not None and T and sent is not None:
+                keep = kernels.keep_from_sent(sent).astype(vc.dtype)
                 vc = vc * keep
                 if m.momentum_masking:
                     mc = mc * keep
@@ -841,7 +1019,7 @@ class FlatDGCEngine:
             return out, {"momentums_c": mc2, "momentums_d": md2,
                          "velocities_c": vc,
                          "velocities_d": mem["velocities_d"],
-                         "keep_c": jnp.ones((T,), self.layout.dtype)}
+                         "sent_c": jnp.zeros((T,), self.layout.dtype)}
 
         gc, gd = flat_grad[:T], flat_grad[T:]
         if m is not None:
@@ -857,28 +1035,33 @@ class FlatDGCEngine:
                 # compensate (reference memory.py:52-53)
                 gc = self._clip_block(gc, self.layout.compressed_names, 0)
             # deferred masking (memory.py:72-77): the PREVIOUS step's
-            # transmit mask is applied on read inside the compensate pass.
-            # x*0 == set-to-0 for finite values, and the sentinel slot is a
-            # structural zero, so padded payload slots are no-ops.
-            comp, mc, vc = self._compensate_acc(mc, vc, gc, mem["keep_c"])
+            # transmit counts are applied on read inside the compensate
+            # pass. x*0 == set-to-0 for finite values, and the sentinel
+            # slot is a structural zero, so padded payload slots are no-ops.
+            comp, mc, vc = self._compensate_acc(mc, vc, gc, mem["sent_c"])
         else:
             comp = gc
         values, indices = self.sparsify(comp, key)
-        if m is not None:
-            # record THIS step's transmit mask for the next compensate —
-            # a scatter into a fresh f32 ones buffer (the fast path);
-            # scatter-set into the live mmt/vec buffers measured 1.8 ms
-            # on v5e, and sub-word masks scatter via a serial while-loop
-            new_keep = jnp.ones((T,), vc.dtype).at[indices].set(0.0)
 
         wire_values = (values.astype(jnp.float16)
                        if self.c.fp16_values else values)
         g_values = jax.lax.all_gather(wire_values, axis_name)  # [W, payload]
         g_indices = jax.lax.all_gather(indices, axis_name)
 
-        acc = jnp.zeros((T,), flat_grad.dtype)
-        acc = acc.at[g_indices.reshape(-1)].add(
-            g_values.reshape(-1).astype(flat_grad.dtype))
+        dt = flat_grad.dtype
+        # two separate fresh-buffer scatters, deliberately: a single fused
+        # scatter into a [2T] buffer (decompress half + count half) was
+        # measured on v5e and LOSES — the scatter itself costs the same
+        # (0.75 vs 0.75+0.30 ms) but slicing the halves back out
+        # materializes a 0.66 ms loop fusion, a net +0.4 ms/step
+        # (device profile, scripts/profile_step.py). Scatter-set into the
+        # live mmt/vec buffers (1.8 ms) and sub-word masks (serial
+        # while-loop) stay avoided.
+        acc = jnp.zeros((T,), dt).at[g_indices.reshape(-1)].add(
+            g_values.reshape(-1).astype(dt))
+        if m is not None:
+            # THIS step's transmit-count record for the next compensate
+            new_sent = jnp.zeros((T,), dt).at[indices].add(1.0)
         # /world_size only under Average (compression.py:192-193)
         out_c = acc / world_size if op == "average" else acc
 
@@ -897,7 +1080,7 @@ class FlatDGCEngine:
         if m is not None:
             mem = {"momentums_c": mc, "velocities_c": vc,
                    "momentums_d": md, "velocities_d": mem["velocities_d"],
-                   "keep_c": new_keep}
+                   "sent_c": new_sent}
         return out, mem
 
     # -------------------------------------------------------------- #
@@ -908,13 +1091,13 @@ class FlatDGCEngine:
         """Split memory -> canonical {momentums: [P], velocities: [P]}
         view, with any pending (deferred) transmit mask materialized —
         checkpoint/inspection time only, the hot path never builds it.
-        The keep vector is ratio-independent ([T] never changes), so a
-        pending mask survives warm-up engine rebuilds untouched — the next
-        compensate applies it identically."""
+        The sent-count vector is ratio-independent ([T] never changes), so
+        a pending mask survives warm-up engine rebuilds untouched — the
+        next compensate applies it identically."""
         mc, vc = mem["momentums_c"], mem["velocities_c"]
         m = self._mem
         if m is not None and mc.shape[0] > 0:
-            keep = mem["keep_c"].astype(vc.dtype)
+            keep = kernels.keep_from_sent(mem["sent_c"]).astype(vc.dtype)
             vc = vc * keep
             if m.momentum_masking:
                 mc = mc * keep
@@ -955,7 +1138,7 @@ class FlatDGCEngine:
             out[key + "_c"] = flat[:T]
             out[key + "_d"] = flat[T:]
         # loaded buffers are canonical (already masked): nothing pending
-        out["keep_c"] = jnp.ones((T,), self.layout.dtype)
+        out["sent_c"] = jnp.zeros((T,), self.layout.dtype)
         return out
 
 

@@ -36,6 +36,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_compensate", "fused_compensate_reference",
            "fused_compensate_masked", "fused_compensate_masked_reference",
+           "keep_from_sent",
            "ladder_counts", "ladder_counts_reference",
            "topk_rows", "topk_rows_reference", "use_pallas"]
 
@@ -119,15 +120,25 @@ def fused_compensate(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
     return (om[:n], ov[:n]) if pad else (om, ov)
 
 
-def fused_compensate_masked_reference(grad, mmt, vec, keep, momentum: float,
+def keep_from_sent(sent):
+    """Transmit-count -> multiplicative keep mask: 1.0 where the coordinate
+    was NOT transmitted last step (count 0), else 0.0. The engine records
+    counts, not masks, so the record rides the decompress scatter-add
+    (one fused [2T] scatter) instead of a second scatter into a ones
+    buffer; this conversion runs INSIDE the compensate pass."""
+    return (sent == 0).astype(sent.dtype)
+
+
+def fused_compensate_masked_reference(grad, mmt, vec, sent, momentum: float,
                                       nesterov: bool, momentum_masking: bool):
     """jnp reference: apply the previous step's transmit mask on READ, then
     compensate. Bitwise identical to masking eagerly after the previous
     sparsify (multiply is deterministic), but the mask multiply rides the
     compensate pass instead of costing its own full-buffer write+read
     (reference order: memory.update zeros transmitted coords, memory.py:
-    72-77; the next compensate reads them, memory.py:50-63)."""
-    kf = keep.astype(vec.dtype)
+    72-77; the next compensate reads them, memory.py:50-63). ``sent`` is
+    the transmit COUNT vector (0 = keep), see :func:`keep_from_sent`."""
+    kf = keep_from_sent(sent).astype(vec.dtype)
     m_in = mmt * kf if momentum_masking else mmt
     return fused_compensate_reference(grad, m_in, vec * kf, momentum,
                                       nesterov)
@@ -136,10 +147,10 @@ def fused_compensate_masked_reference(grad, mmt, vec, keep, momentum: float,
 def _compensate_masked_kernel(g_ref, m_ref, v_ref, k_ref, om_ref, ov_ref, *,
                               momentum, nesterov, momentum_masking):
     g = g_ref[:]
-    # keep is 0/1 in the grad dtype already (f32 engine mask — sub-word
-    # masks are NOT used: their scatter lowers to a serial while-loop on
-    # v5e, see FlatDGCEngine.init_memory); astype is a no-op safety net
-    keep = k_ref[:].astype(g.dtype)
+    # sent is the f32 transmit count (sub-word masks are NOT used: their
+    # scatter lowers to a serial while-loop on v5e, see
+    # FlatDGCEngine.init_memory); 0 means keep
+    keep = (k_ref[:] == 0).astype(g.dtype)
     m0 = m_ref[:] * keep if momentum_masking else m_ref[:]
     v0 = v_ref[:] * keep
     if nesterov:
@@ -154,26 +165,26 @@ def _compensate_masked_kernel(g_ref, m_ref, v_ref, k_ref, om_ref, ov_ref, *,
 @functools.partial(jax.jit, static_argnames=("momentum", "nesterov",
                                              "momentum_masking"))
 def fused_compensate_masked(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
-                            keep: jax.Array, momentum: float,
+                            sent: jax.Array, momentum: float,
                             nesterov: bool = False,
                             momentum_masking: bool = True
                             ) -> Tuple[jax.Array, jax.Array]:
     """Single-pass mask-on-read + compensate over flat buffers: reads
-    (grad, mmt, vec, keep 0/1), writes (mmt', vec') — one extra input
+    (grad, mmt, vec, sent count), writes (mmt', vec') — one extra input
     stream vs :func:`fused_compensate` instead of a separate masked-buffer
     materialization (measured 0.83 ms/step of full-[T] traffic at
-    ResNet-50 scale on v5e). ``keep`` is any multiplicative-identity dtype
-    (the engine uses f32: sub-word scatters lower to a serial while-loop
-    on v5e)."""
+    ResNet-50 scale on v5e). ``sent`` is the transmit-count vector
+    (:func:`keep_from_sent`; 0 = keep), f32: sub-word scatters lower to a
+    serial while-loop on v5e."""
     n = grad.shape[0]
     pad = (-n) % (_SUBLANE * _LANE)
     if pad:
         grad, mmt, vec = (jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
                           for x in (grad, mmt, vec))
-        keep = jnp.concatenate([keep, jnp.ones((pad,), keep.dtype)])
+        sent = jnp.concatenate([sent, jnp.zeros((pad,), sent.dtype)])
     rows = (n + pad) // _LANE
     shape2d = (rows, _LANE)
-    g2, m2, v2, k2 = (x.reshape(shape2d) for x in (grad, mmt, vec, keep))
+    g2, m2, v2, k2 = (x.reshape(shape2d) for x in (grad, mmt, vec, sent))
 
     block_rows = min(_CHUNK_ROWS, rows)
     grid = pl.cdiv(rows, block_rows)

@@ -1,0 +1,97 @@
+"""Paired full-step DGC-vs-dense overhead at ImageNet scale on the real
+TPU chip (the ResNet-50 / VGG-16-BN rows of docs/RESULTS.md).
+
+Reuses bench.py's scan-K + one-readback + interleaved-rounds methodology
+(the only honest timing on this relay backend — see bench.py's module
+docstring). Prints the paired per-round overheads and their median/IQR.
+
+Usage: python scripts/bench_model.py [--model resnet50|vgg16_bn|resnet20]
+           [--bs 32] [--k 40] [--repeats 8] [--ratio 0.001]
+"""
+
+import argparse
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--bs", type=int, default=32)
+    ap.add_argument("--k", type=int, default=40)
+    ap.add_argument("--repeats", type=int, default=8)
+    ap.add_argument("--ratio", type=float, default=0.001)
+    args = ap.parse_args()
+
+    import bench
+    from dgc_tpu import (Compression, DGCCompressor, DGCSGDMemory,
+                         DistributedOptimizer, dgc_sgd, sgd)
+    from dgc_tpu import models
+    from dgc_tpu.parallel import make_mesh
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+    from dgc_tpu.utils.pytree import named_flatten
+
+    model = getattr(models, args.model)()
+    size = 32 if args.model.startswith("resnet2") else 224
+    ncls = 10 if size == 32 else 1000
+
+    devices = jax.devices()
+    W = len(devices)
+    mesh = make_mesh(W)
+    rtt = bench._measure_rtt()
+    print(f"devices {W}, RTT {rtt:.1f} ms", file=sys.stderr)
+
+    npr = np.random.RandomState(0)
+    images = jax.device_put(jnp.asarray(
+        npr.randn(W * args.bs, size, size, 3), jnp.float32))
+    labels = jax.device_put(jnp.asarray(
+        npr.randint(0, ncls, W * args.bs), jnp.int32))
+    v = model.init(jax.random.PRNGKey(42), jnp.zeros((1, size, size, 3)),
+                   train=True)
+    named, _ = named_flatten(v["params"])
+
+    def prepare(dist):
+        setup = make_flat_setup(v, dist)
+        state = shard_state(make_flat_state(v, dist, setup, W), mesh,
+                            dist_opt=dist)
+        step = build_train_step(model.apply, dist, mesh, donate=False,
+                                use_dropout="vgg" in args.model,
+                                flat=setup)
+        return (bench._make_k_loop(step, images, labels, args.k),
+                state), setup
+
+    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dgc_run, setup = prepare(DistributedOptimizer(
+        dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))
+    dense_run, _ = prepare(DistributedOptimizer(
+        sgd(0.1, momentum=0.9, weight_decay=1e-4), Compression.none(),
+        world_size=W))
+    print(f"model={args.model} P={setup.layout.num_params} "
+          f"payload={setup.engine.payload_size}", file=sys.stderr)
+
+    rows = bench._interleaved_step_ms(
+        [dgc_run, dense_run], rtt, k=args.k, repeats=args.repeats,
+        max_repeats=3 * args.repeats)
+    dgc_ms, dense_ms = (min(col) for col in zip(*rows))
+    diffs = [d - b for d, b in rows]
+    med = statistics.median(diffs)
+    q1, q3 = (float(x) for x in np.percentile(diffs, [25, 75]))
+    print(f"dgc step:   {dgc_ms:.3f} ms", file=sys.stderr)
+    print(f"dense step: {dense_ms:.3f} ms", file=sys.stderr)
+    print(f"per-round overheads: {[round(x, 3) for x in diffs]}",
+          file=sys.stderr)
+    print(f"OVERHEAD median {med:.3f} ms  IQR [{q1:.3f}, {q3:.3f}]  "
+          f"({100 * med / dense_ms:.1f}% of dense step)")
+
+
+if __name__ == "__main__":
+    main()

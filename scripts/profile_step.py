@@ -1,0 +1,165 @@
+"""Device-profile decomposition of the DGC vs dense train step.
+
+Traces K steps of each config with jax.profiler, parses the xplane proto
+(tensorboard_plugin_profile), aggregates per-op device durations, and
+prints the top ops per config plus a diff view — the attribution tool
+behind docs/RESULTS.md's overhead decomposition. Isolated micro-benches on
+this backend are floor-dominated and DCE-prone (see bench.py); the profile
+measures the shipped program.
+
+Usage: python scripts/profile_step.py [--model resnet50] [--bs 32] [--k 10]
+"""
+
+import argparse
+import glob
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_trace(logdir, repo_root):
+    """Aggregate LEAF device ops of the newest Chrome-trace JSON under
+    logdir. Returns (by_source, by_name, leaf_total_ms): by_source groups
+    ops by their `source` file:line attribution (repo paths shortened),
+    by_name keeps individual op names with sample metadata. Envelope
+    events (jit_* / while.* wrappers) are excluded from totals."""
+    import gzip
+    import json as jsonlib
+    paths = sorted(glob.glob(os.path.join(
+        logdir, "plugins/profile/*/*.trace.json.gz")), key=os.path.getmtime)
+    assert paths, f"no trace.json.gz under {logdir}"
+    with gzip.open(paths[-1], "rt") as f:
+        trace = jsonlib.load(f)
+    events = trace.get("traceEvents", [])
+    pid_name = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_name[ev.get("pid")] = ev.get("args", {}).get("name", "")
+    by_source = defaultdict(float)
+    by_name = defaultdict(lambda: [0.0, None])
+    leaf_total = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        pname = pid_name.get(ev.get("pid"), "").lower()
+        if "tpu" not in pname or "host" in pname:
+            continue
+        name = ev["name"]
+        if name.startswith(("jit_", "while", "Overhead", "idle")):
+            continue  # envelopes / non-op lanes
+        args = ev.get("args", {}) or {}
+        if "hlo_category" not in args:
+            continue  # step-number / module lanes double-count the ops
+        ms = ev["dur"] / 1e3
+        src = args.get("source", "")
+        src = src.replace(repo_root + "/", "").replace(
+            "scripts/../", "")
+        cat = args.get("hlo_category", "?")
+        if "site-packages" in src or not src:
+            tfop = args.get("tf_op", "")
+            key = ("model" if "ResNet" in tfop or "transpose" in tfop
+                   or "conv" in tfop else f"lib:{cat}")
+        else:
+            key = f"{src} [{cat}]"
+        by_source[key] += ms
+        by_name[name][0] += ms
+        if by_name[name][1] is None:
+            by_name[name][1] = (src, cat, args.get("tf_op", "")[-80:])
+        leaf_total += ms
+    return dict(by_source), dict(by_name), leaf_total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--bs", type=int, default=32)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--ratio", type=float, default=0.001)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--out", default="/tmp/dgc_profile")
+    args = ap.parse_args()
+
+    import bench
+    from dgc_tpu import (Compression, DGCCompressor, DGCSGDMemory,
+                         DistributedOptimizer, dgc_sgd, sgd)
+    from dgc_tpu import models
+    from dgc_tpu.parallel import make_mesh
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+    from dgc_tpu.utils.pytree import named_flatten
+
+    model = getattr(models, args.model)()
+    size = 32 if args.model.startswith("resnet2") else 224
+    ncls = 10 if size == 32 else 1000
+    W = len(jax.devices())
+    mesh = make_mesh(W)
+    npr = np.random.RandomState(0)
+    images = jax.device_put(jnp.asarray(
+        npr.randn(W * args.bs, size, size, 3), jnp.float32))
+    labels = jax.device_put(jnp.asarray(
+        npr.randint(0, ncls, W * args.bs), jnp.int32))
+    v = model.init(jax.random.PRNGKey(42), jnp.zeros((1, size, size, 3)),
+                   train=True)
+    named, _ = named_flatten(v["params"])
+
+    def prepare(dist):
+        setup = make_flat_setup(v, dist)
+        state = shard_state(make_flat_state(v, dist, setup, W), mesh,
+                            dist_opt=dist)
+        step = build_train_step(model.apply, dist, mesh, donate=False,
+                                use_dropout="vgg" in args.model,
+                                flat=setup)
+        return bench._make_k_loop(step, images, labels, args.k), state
+
+    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    runs = {
+        "dgc": prepare(DistributedOptimizer(
+            dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp,
+            world_size=W)),
+        "dense": prepare(DistributedOptimizer(
+            sgd(0.1, momentum=0.9, weight_decay=1e-4), Compression.none(),
+            world_size=W)),
+    }
+
+    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    _ssum = jax.jit(lambda x: jnp.sum(x))
+    per_config = {}
+    for name, (k_loop, state) in runs.items():
+        state, _ = k_loop(state, jax.random.PRNGKey(0))  # compile + warm
+        float(_ssum(state.params))
+        logdir = os.path.join(args.out, name)
+        os.makedirs(logdir, exist_ok=True)
+        with jax.profiler.trace(logdir):
+            state, _ = k_loop(state, jax.random.PRNGKey(1))
+            float(_ssum(state.params))
+        by_source, by_name, leaf_total = parse_trace(logdir, repo_root)
+        per_config[name] = by_source
+        print(f"\n=== {name}: leaf device total {leaf_total / args.k:.3f} "
+              f"ms/step ===")
+        for nm, (ms, meta) in sorted(by_name.items(),
+                                     key=lambda kv: -kv[1][0])[:args.top]:
+            print(f"  {ms / args.k:8.4f}  {nm:<36s} {meta}")
+
+    d, b = per_config["dgc"], per_config["dense"]
+    print("\n=== per-source decomposition: DGC minus dense (ms/step) ===")
+    keys = sorted(set(d) | set(b),
+                  key=lambda k: -(d.get(k, 0.0) - b.get(k, 0.0)))
+    tot = 0.0
+    for k in keys:
+        delta = (d.get(k, 0.0) - b.get(k, 0.0)) / args.k
+        tot += delta
+        if abs(delta) > 0.02:
+            print(f"  {delta:+8.4f}  {k}  (dgc {d.get(k, 0) / args.k:.3f} "
+                  f"dense {b.get(k, 0) / args.k:.3f})")
+    print(f"  TOTAL leaf delta: {tot:+.3f} ms/step")
+
+
+if __name__ == "__main__":
+    main()

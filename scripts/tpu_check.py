@@ -1,0 +1,138 @@
+"""Driver-runnable on-TPU regression check (VERDICT r2 item 5 / SURVEY §7
+item 6 bit-compatibility contract).
+
+CPU pytest runs the Pallas kernels in interpret mode and approx_max_k
+lowers to an exact sort there, so CI cannot catch a Mosaic compilation or
+recall regression. This script runs ON THE REAL CHIP and asserts:
+
+1. compiled-Pallas == interpret-mode (bitwise) for fused_compensate,
+   fused_compensate_masked, ladder_counts, and topk_rows at the engine's
+   ResNet-50 operating shapes;
+2. approx-selection recall >= 0.95 at every ResNet-50 approx bucket
+   (exact top-k reference computed on the same device).
+
+Prints ONE JSON line like bench.py:
+{"metric": "tpu_regression_check", "value": 1|0, "unit": "pass",
+ "kernels": {...}, "recall": {...}} — value 1 means every check passed.
+
+Usage: python scripts/tpu_check.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_kernels():
+    """Compiled vs interpret equality at engine shapes. Returns
+    {name: bool}."""
+    from dgc_tpu.ops import kernels
+
+    assert kernels.use_pallas(), (
+        "tpu_check must run on a TPU backend (jax.default_backend()="
+        f"{jax.default_backend()})")
+    rng = np.random.RandomState(0)
+    out = {}
+
+    # fused compensate at a [T]-scale but CI-friendly size (shape doesn't
+    # change the kernel's grid logic beyond chunk count; 2M spans >1 chunk)
+    n = 2_097_152 + 4096
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n), jnp.float32)
+    v = jnp.asarray(rng.randn(n), jnp.float32)
+    sent = jnp.asarray((rng.rand(n) < 0.001).astype(np.float32))
+
+    cm, cv = kernels.fused_compensate(g, m, v, 0.9, False)
+    rm, rv = kernels.fused_compensate_reference(g, m, v, 0.9, False)
+    out["fused_compensate"] = bool(
+        np.array_equal(np.asarray(cm), np.asarray(rm))
+        and np.array_equal(np.asarray(cv), np.asarray(rv)))
+
+    cm, cv = kernels.fused_compensate_masked(g, m, v, sent, 0.9, True, True)
+    rm, rv = kernels.fused_compensate_masked_reference(
+        g, m, v, sent, 0.9, True, True)
+    out["fused_compensate_masked"] = bool(
+        np.array_equal(np.asarray(cm), np.asarray(rm))
+        and np.array_equal(np.asarray(cv), np.asarray(rv)))
+
+    # ladder counts at a ResNet-50 bucket shape (rows unpadded: the kernel
+    # pads in-trace)
+    imp = jnp.asarray(np.abs(rng.randn(17, 262144)).astype(np.float32))
+    thr = jnp.asarray(np.quantile(np.asarray(imp), 0.999, axis=1),
+                      jnp.float32)
+    ck = kernels.ladder_counts(imp, thr, 0.8, 11)
+    rk = kernels.ladder_counts_reference(imp, thr, 0.8, 11)
+    out["ladder_counts"] = bool(np.array_equal(np.asarray(ck),
+                                               np.asarray(rk)))
+
+    # topk_rows at the gated operating point (k*cols < 2M -> kernel path)
+    x = jnp.asarray(rng.randn(22, 36864), jnp.float32)
+    cv_, ci_ = kernels.topk_rows(x, 37)
+    rv_, ri_ = kernels.topk_rows_reference(x, 37)
+    out["topk_rows"] = bool(
+        np.array_equal(np.asarray(cv_), np.asarray(rv_))
+        and np.array_equal(np.asarray(ci_), np.asarray(ri_)))
+    return out
+
+
+def check_recall(threshold: float = 0.95):
+    """Engine approx-selection recall at the ResNet-50 approx buckets.
+    Returns {bucket: recall}."""
+    from dgc_tpu import DGCCompressor, DGCSGDMemory
+    from dgc_tpu.compression.flat import FlatDGCEngine, ParamLayout
+    from dgc_tpu.models import resnet50
+    from dgc_tpu.utils.pytree import named_flatten
+
+    model = resnet50()
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                   train=True)
+    named, _ = named_flatten(v["params"])
+    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    layout = ParamLayout.for_compressor(v["params"], comp)
+    engine = FlatDGCEngine(comp, layout)
+
+    rng = np.random.RandomState(1)
+    out = {}
+    for bi, b in enumerate(engine.buckets):
+        R, cols, k = b.rows, b.cols, b.max_sel
+        if not (comp.approx_recall is not None
+                and (k > 128 or k * cols > 2_000_000)):
+            continue  # exact path
+        x = jax.device_put(jnp.abs(jnp.asarray(
+            rng.randn(R, cols), jnp.float32)))
+        _, ai = jax.jit(lambda s: engine._select_topk(s, k))(x)
+        _, ei = jax.jit(lambda s: jax.lax.top_k(s, k))(x)
+        ai_n, ei_n = np.asarray(ai), np.asarray(ei)
+        hits = [len(np.intersect1d(ai_n[r], ei_n[r])) / k for r in range(R)]
+        out[f"bucket{bi}_{R}x{cols}_k{k}"] = round(float(np.mean(hits)), 4)
+    return out
+
+
+def main():
+    kernels_ok = check_kernels()
+    recall = check_recall()
+    ok = all(kernels_ok.values()) and all(r >= 0.95 for r in recall.values())
+    for name, good in kernels_ok.items():
+        print(f"[kernel] {name}: {'OK (bitwise)' if good else 'MISMATCH'}",
+              file=sys.stderr)
+    for name, r in recall.items():
+        print(f"[recall] {name}: {r}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "tpu_regression_check",
+        "value": 1 if ok else 0,
+        "unit": "pass",
+        "kernels": kernels_ok,
+        "recall": recall,
+    }))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,10 +37,38 @@ def test_engine_builds_at_imagenet_scale(name):
     # wire volume == reference's sum of per-tensor num_selects
     assert engine.payload_size == sum(
         a.num_selects for a in comp.attributes.values())
-    # every compressed tensor is in exactly one bucket row
+    # every compressed tensor is in one bucket row, except giant tensors
+    # (> _SPLIT_COLS) which split into segment rows with the SAME total
+    # quota (stratified selection; wire volume asserted above)
+    from dgc_tpu.compression.flat import _SPLIT_COLS
+    from dgc_tpu.ops.kernels import ladder_cols
+    split_tensors = sum(
+        1 for a in comp.attributes.values()
+        if ladder_cols(a.numel) > _SPLIT_COLS and a.num_selects >= 2)
     rows = sum(b.rows for b in engine.buckets)
-    assert rows == len(comp.attributes)
-    # bucket padding bounded by the build factor
+    assert rows >= len(comp.attributes)
+    if split_tensors == 0:
+        assert rows == len(comp.attributes)
+    else:
+        assert rows > len(comp.attributes)
+        # split buckets (more rows than layout names): segment quotas sum
+        # EXACTLY to the tensor's num_selects and segment numels cover the
+        # tensor exactly — the quota/coverage invariant of _segment_rows
+        lay_by_base = {g.base: g for g in layout.buckets}
+        found_split = 0
+        for b in engine.buckets:
+            g = lay_by_base[b.base]
+            if b.rows == len(g.names):
+                continue
+            [tname] = g.names
+            a = comp.attributes[tname]
+            found_split += 1
+            assert int(b.num_selects.sum()) == a.num_selects, tname
+            assert int(b.numels.sum()) == a.numel, tname
+            assert (b.num_selects >= 1).all()
+        assert found_split == split_tensors
+    # bucket padding bounded by the build factor (split buckets: per-row
+    # width is the segment width, numels fill it except the last row)
     for b in engine.buckets:
         real = b.numels[:b.rows]
         assert b.cols < 2 * max(int(real.max()), 128) + 128 * 1024
@@ -79,16 +107,18 @@ def test_resnet50_exchange_one_step():
 
 
 def test_approx_recall_knob():
-    """approx_recall defaults to 0.95 and None forces exact top-k — on CPU
-    approx_max_k lowers to exact, so both settings must select identically
-    (the gate itself only changes the op choice at num_selects > 128)."""
+    """approx_recall defaults to 0.90 (measured recall 0.966-0.975 at the
+    ResNet-50 buckets, -0.62 ms/step paired vs 0.95 — flat._select_topk)
+    and None forces exact top-k — on CPU approx_max_k lowers to exact, so
+    both settings must select identically (the gate itself only changes
+    the op choice at num_selects > 128)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer, dgc_sgd
 
-    assert DGCCompressor(0.01).approx_recall == 0.95
+    assert DGCCompressor(0.01).approx_recall == 0.90
     rng = np.random.RandomState(0)
     params = {"w": jnp.asarray(rng.randn(600, 600), jnp.float32)}
 

@@ -79,17 +79,45 @@ def test_layout_roundtrip():
         np.testing.assert_array_equal(np.asarray(p), np.asarray(named[n]))
 
 
-def test_layout_rejects_int32_index_overflow():
-    """A flat buffer at/above 2**31 slots would overflow the int32 wire
-    indices (the always-on int32_indices path) — the layout must refuse it
-    up front and point at the int64 wire format (BASELINE 'int64 idx' row).
-    Shape-only structs keep the test allocation-free."""
+def test_int64_index_wire_path():
+    """A flat buffer at/above 2**31 slots forces the int64 index wire
+    format (BASELINE 'int64 idx' row): the layout reports index_dtype
+    int64, the engine refuses to build without jax x64 mode (clear error,
+    not a silent wrap), and under x64 the traced sparsify emits int64
+    indices with the exact per-tensor payload. Shape-only structs +
+    eval_shape keep the test allocation-free."""
+    from dgc_tpu.compression.flat import FlatDGCEngine
+
     huge = {"w": jax.ShapeDtypeStruct((2 ** 31 + 128,), jnp.float32)}
-    with pytest.raises(ValueError, match="int32"):
-        ParamLayout(huge, ["w"])
-    # just under the ceiling (after alignment) still builds
+    layout = ParamLayout(huge, ["w"])
+    assert layout.index_dtype == np.int64
+    numel = 2 ** 31 + 128
+    comp = DGCCompressor(1e-6, memory=DGCSGDMemory(momentum=0.9))
+    comp.initialize([("w", (numel, (numel,)))])
+    with pytest.raises(RuntimeError, match="x64"):
+        FlatDGCEngine(comp, layout)
+    with jax.enable_x64(True):
+        engine = FlatDGCEngine(comp, layout)
+        assert engine.index_dtype == jnp.int64
+        assert engine.payload_size == comp.attributes["w"].num_selects
+        out = jax.eval_shape(
+            engine.sparsify,
+            jax.ShapeDtypeStruct((layout.t_compressed,), jnp.float32),
+            jax.random.PRNGKey(0))
+        assert out[1].dtype == jnp.int64
+        assert out[0].shape == out[1].shape == (engine.payload_size,)
+    # small layouts keep the int32 wire unless explicitly asked otherwise
     ok = {"w": jax.ShapeDtypeStruct((2 ** 20,), jnp.float32)}
-    assert ParamLayout(ok, ["w"]).total < 2 ** 31
+    small = ParamLayout(ok, ["w"])
+    assert small.index_dtype == np.int32
+    comp2 = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9),
+                          int32_indices=False)
+    comp2.initialize([("w", (2 ** 20, (2 ** 20,)))])
+    # int64-by-config also requires x64 (same clear error)
+    with pytest.raises(RuntimeError, match="x64"):
+        FlatDGCEngine(comp2, small)
+    with jax.enable_x64(True):
+        assert FlatDGCEngine(comp2, small).index_dtype == jnp.int64
 
 
 def test_layout_mask_vector():
@@ -322,6 +350,109 @@ def test_flat_sparsify_selects_topk(mesh8):
                 assert vals[list(idx).index(i)] == seg[i - off]
 
 
+def test_ladder_from_topk_matches_full_scan():
+    """The hot path derives the resample ladder from the selection top-k
+    (flat._ladder_adapt_from_topk); it must choose the IDENTICAL adapted
+    threshold as the full [R, cols] ladder scan (flat._ladder_adapt) for
+    exact top-k — across descending, immediately-passing, and saturated
+    count regimes."""
+    from dgc_tpu.compression.flat import _ladder_adapt, _ladder_adapt_from_topk
+
+    rng = np.random.RandomState(11)
+    R, cols, k = 6, 4096, 64
+    imp = jnp.asarray(np.abs(rng.randn(R, cols)).astype(np.float32))
+    num_selects = jnp.asarray(
+        rng.randint(8, k + 1, R).astype(np.float32))
+    adapt = jnp.asarray(np.array([True] * (R - 1) + [False]))
+    top_scores = jax.lax.top_k(imp, k)[0]
+    for scale in (8.0, 1.0, 0.05):  # high thr (descends) .. low (saturates)
+        # per-row thresholds near the selection quantile, scaled
+        thr = top_scores[:, k // 2] * scale
+        a = _ladder_adapt(imp, thr, num_selects, adapt, 0.8, 10)
+        b = _ladder_adapt_from_topk(top_scores, thr, num_selects, adapt,
+                                    0.8, 10)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"scale {scale}")
+
+
+def test_lane_block_sampling_quantile():
+    """Lane-block strided sampling: (a) the drawn sample count tracks the
+    geometry's num_samples (the old nb = n // 128 truncation drew as
+    little as half the budget), and (b) the sampled threshold estimates
+    the target quantile — the fraction of elements above the raw
+    (pre-adaptation) threshold stays within a constant factor of the
+    compress ratio across random phases, at a moderate stride."""
+    ratio, numel = 0.01, 120_000
+    comp = DGCCompressor(ratio, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=0.05, max_adaptation_iters=0)
+    comp.initialize([("w", (numel, (300, 400)))])
+    a = comp.attributes["w"]
+    assert a.sample_stride > 1  # genuinely strided
+    params = {"w": jnp.zeros((300, 400), jnp.float32)}
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+    layout, engine = dist.make_flat(params)
+    [b] = engine.buckets
+
+    rng = np.random.RandomState(5)
+    data = rng.randn(numel).astype(np.float32)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:numel] = data
+    block = jnp.asarray(vec[:b.rows * b.cols]).reshape(b.rows, b.cols)
+    col = jnp.arange(b.cols)[None, :]
+    imp_rows = jnp.where(col < int(a.numel), jnp.abs(block), -1.0)
+
+    sample_fn = jax.jit(lambda k: engine._sample_rows(b, imp_rows, k))
+    fractions = []
+    for seed in range(30):
+        smp = np.asarray(sample_fn(jax.random.PRNGKey(seed)))
+        drawn = int((smp >= 0).sum())
+        # (a) budget: within [1.0, 1.0 + lane-block rounding slack]
+        assert a.num_samples <= drawn <= a.num_samples + 128, drawn
+        # (b) threshold = top_k_samples-th largest sample (engine rule)
+        thr = np.sort(smp[smp >= 0])[-a.top_k_samples]
+        fractions.append((np.abs(data) >= thr).sum() / numel)
+    med = float(np.median(fractions))
+    # quantile error bounded: the ladder's one-sided correction (x0.8 per
+    # level) easily covers a [0.4, 2.5]x band
+    assert 0.4 * ratio <= med <= 2.5 * ratio, med
+
+
+def test_split_bucket_stratified_selection(monkeypatch):
+    """Giant single-tensor rows split into segments (flat._SPLIT_COLS):
+    the per-tensor quota distributes exactly across segments and, with
+    deterministic sampling, each segment selects exactly its top-quota
+    coordinates (stratified selection; payload/wire volume unchanged)."""
+    import dgc_tpu.compression.flat as flat
+
+    monkeypatch.setattr(flat, "_SPLIT_COLS", 1024)
+    monkeypatch.setattr(flat, "_SPLIT_TARGET", 1024)
+    params = {"w": {"kernel": jnp.zeros((64, 128), jnp.float32)}}
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=1.0)
+    comp.initialize([("w/kernel", (8192, (64, 128)))])
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+    layout, engine = dist.make_flat(params)
+    a = comp.attributes["w/kernel"]
+    [b] = engine.buckets
+    assert b.rows > 1 and b.rows * b.cols == 8192
+    assert int(b.num_selects.sum()) == a.num_selects  # exact quota total
+    assert engine.payload_size == a.num_selects
+
+    rng = np.random.RandomState(3)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:8192] = rng.randn(8192).astype(np.float32)
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                         jax.random.PRNGKey(0))
+    idx = np.asarray(idx)
+    got = set(int(i) for i in idx if i < 8192)
+    expect = set()
+    for s in range(b.rows):
+        seg = vec[s * b.cols:(s + 1) * b.cols]
+        ns = int(b.num_selects[s])
+        expect.update(s * b.cols + np.argsort(-np.abs(seg))[:ns])
+    assert got == expect
+
+
 def test_flat_dense_exchange_psum(mesh8):
     params = _params()
     dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=W)
@@ -490,7 +621,7 @@ def test_flat_memory_state_dict_roundtrip():
     params, comp, dist = _make_dist(sample_ratio=1.0, ratio=0.05)
     layout, engine = dist.make_flat(params)
     mem = engine.init_memory()
-    mem = {k: v if k == "keep_c"
+    mem = {k: v if k == "sent_c"
            else v + (1.0 if k.startswith("momentums") else 2.0)
            for k, v in mem.items()}
     sd = engine.memory_state_dict(mem)

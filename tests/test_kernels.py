@@ -40,11 +40,13 @@ def test_fused_compensate_masked_matches_reference(n, nesterov,
     g = jnp.asarray(rng.randn(n), jnp.float32)
     m = jnp.asarray(rng.randn(n), jnp.float32)
     v = jnp.asarray(rng.randn(n), jnp.float32)
-    keep = jnp.asarray(rng.rand(n) > 0.3, jnp.float32)
-    om, ov = kernels.fused_compensate_masked(g, m, v, keep, 0.9, nesterov,
+    # sent = transmit counts (0 = keep); keep = (sent == 0)
+    sent = jnp.asarray(rng.rand(n) < 0.3, jnp.float32)
+    keep = kernels.keep_from_sent(sent)
+    om, ov = kernels.fused_compensate_masked(g, m, v, sent, 0.9, nesterov,
                                              momentum_masking)
     rm, rv = kernels.fused_compensate_masked_reference(
-        g, m, v, keep, 0.9, nesterov, momentum_masking)
+        g, m, v, sent, 0.9, nesterov, momentum_masking)
     np.testing.assert_allclose(np.asarray(om), np.asarray(rm),
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(ov), np.asarray(rv),
