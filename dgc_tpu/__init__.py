@@ -20,7 +20,7 @@ PIL+numpy instead of paying seconds of jax import and hundreds of MB of RSS
 per worker.
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 _EXPORTS = {
     "DGCCompressor": "dgc_tpu.compression.dgc",

@@ -55,6 +55,15 @@ class DGCSGDMemory(Memory):
     Mirrors reference ``DGCSGDMemory`` (memory.py:31-88). ``gradient_clipping``
     is an optional pure function ``grad -> grad`` applied before correction
     (pluggable, see ``dgc_tpu.utils.clip_grad``).
+
+    **Contract for custom clipping callables**: the function must be
+    *padding-invariant* — appending zeros to the input must change no
+    output value (appended zeros clip back to zeros and affect no norm).
+    Every ``dgc_tpu.utils.clip_grad`` function satisfies this. The flat
+    engine batches whole buckets through one ``vmap`` over zero-padded
+    row views (``FlatDGCEngine._clip_block``), so a callable that depends
+    on the tensor's length (e.g. scaling by ``numel``) would clip
+    incorrectly there with no error raised.
     """
 
     def __init__(self, momentum: float = 0.9, nesterov: bool = False,

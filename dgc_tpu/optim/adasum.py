@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from dgc_tpu.optim.distributed import DistributedOptimizer
+from dgc_tpu.utils.pytree import named_flatten, named_unflatten
 
 __all__ = ["adasum_pair", "adasum_reduce", "adasum_allreduce",
            "AdasumDistributedOptimizer"]
@@ -104,9 +105,37 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
                 "exchange; use the default DistributedOptimizer or flat DP")
 
     def update(self, grads, opt_state, params, mem_state, key=None):
-        raise NotImplementedError(
-            "Adasum is implemented for the flat-engine path; use "
-            "update_flat (build the train step with flat=...)")
+        """Per-tensor Adasum delta exchange (reference
+        _DistributedAdasumOptimizer, optimizer.py:197-367): the base
+        optimizer steps on LOCAL gradients first (:267-275), then each
+        tensor's delta goes through the compressor — sparse payloads
+        allgather + scatter-add SUM (the reference's decompress divides
+        only under Average, compression.py:192-193), dense-fallback
+        deltas combine with the true pairwise Adasum operator
+        (:283-310's ``op=Adasum`` allreduce) and take the
+        non-accumulating momentum correction like any fallback tensor
+        (compression.py:198). Parity path, not a performance one — the
+        flat-engine :meth:`update_flat` is the fast route."""
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        named, treedef = named_flatten(updates)
+        comp = self.compressor
+        out = {}
+        for i, (name, delta) in enumerate(named.items()):
+            k = jax.random.fold_in(key, i) if key is not None else None
+            payload, ctx, mem_state = comp.compress(mem_state, name, delta,
+                                                    k)
+            if getattr(ctx, "compressed", False):
+                gathered = comp.communicate(payload, ctx, self.axis_name,
+                                            self.world_size)
+                out[name], mem_state = comp.decompress(
+                    gathered, ctx, mem_state, self.world_size, op="adasum")
+            else:
+                red = adasum_allreduce(delta, self.axis_name,
+                                       self.world_size)
+                corrected, mem_state = comp.memory.compensate(
+                    mem_state, name, red.reshape(-1), accumulate=False)
+                out[name] = corrected.reshape(delta.shape)
+        return named_unflatten(out, treedef), opt_state, mem_state
 
     def update_flat(self, flat_grads, opt_state, flat_params, mem_state,
                     key, engine) -> Tuple[jax.Array, object, dict]:

@@ -44,7 +44,8 @@ class CheckpointManager:
         return os.path.join(self.directory, "latest.json")
 
     def save(self, epoch: int, state: Any, meters: Dict[str, float],
-             best: bool = False) -> str:
+             best: bool = False,
+             topology: Optional[Dict[str, int]] = None) -> str:
         """Save epoch checkpoint, update latest pointer, rotate, track best.
 
         Multi-process (``jax.process_count() > 1``): EVERY process must
@@ -77,6 +78,12 @@ class CheckpointManager:
         with open(os.path.join(path, "meters.json"), "w") as f:
             payload = {k: float(v) for k, v in meters.items()}
             payload["epoch"] = epoch
+            if topology:
+                # process/mesh topology the state was written under —
+                # restoring under a different one would otherwise fail deep
+                # in orbax/XLA with an opaque sharding error (or silently
+                # reinterpret per-worker error-feedback state)
+                payload["_topology"] = dict(topology)
             json.dump(payload, f)
         with open(self._meta_path(), "w") as f:
             json.dump({"epoch": epoch}, f)
@@ -94,6 +101,18 @@ class CheckpointManager:
 
     # ------------------------------------------------------------------ #
 
+    @staticmethod
+    def _legacy_keep_template(template):
+        """Template with the flat engine's 'sent_c' memory key renamed to
+        the v0.2 'keep_c' — None when the state carries no such key (the
+        migration only applies to flat-engine DGC states)."""
+        mem = getattr(template, "memory", None)
+        if not (isinstance(mem, dict) and "sent_c" in mem):
+            return None
+        legacy = dict(mem)
+        legacy["keep_c"] = legacy.pop("sent_c")
+        return template.replace(memory=legacy)
+
     def latest_epoch(self) -> Optional[int]:
         if not os.path.exists(self._meta_path()):
             return None
@@ -101,12 +120,17 @@ class CheckpointManager:
             return int(json.load(f)["epoch"])
 
     def restore(self, template: Any, epoch: Optional[int] = None,
-                best: bool = False
+                best: bool = False,
+                topology: Optional[Dict[str, int]] = None
                 ) -> Optional[Tuple[Any, int, Dict[str, float]]]:
         """Restore (state, epoch, meters); None when nothing to resume.
 
         ``template`` is a freshly-initialized state pytree providing
-        structure/shape/dtype targets.
+        structure/shape/dtype targets. When both the checkpoint and the
+        caller carry a ``topology`` record (process count / mesh shape /
+        tier config), a mismatch raises an explicit error BEFORE the
+        restore instead of failing deep inside orbax/XLA with an opaque
+        sharding message.
         """
         if best:
             path = os.path.join(self.directory, "best")
@@ -121,6 +145,18 @@ class CheckpointManager:
             path = self._epoch_dir(epoch)
             if not os.path.exists(path):
                 return None
+        saved_topology = None
+        meters_path = os.path.join(path, "meters.json")
+        if os.path.exists(meters_path):
+            with open(meters_path) as f:
+                saved_topology = json.load(f).get("_topology")
+        if topology is not None and saved_topology is not None \
+                and dict(saved_topology) != dict(topology):
+            raise RuntimeError(
+                f"checkpoint at {path} was written under topology "
+                f"{saved_topology} but this run has {dict(topology)} — "
+                "resume with the same process/mesh/tier configuration, or "
+                "start a fresh experiment directory")
         if jax.process_count() > 1:
             # restore straight into the live sharded layout: global arrays
             # cannot be host-materialized per process, and the sharding on
@@ -132,17 +168,37 @@ class CheckpointManager:
         else:
             host_template = jax.tree.map(
                 lambda x: np.asarray(jax.device_get(x)), template)
-        try:
-            state = self._ckptr.restore(path, host_template)
+        def _restore_checked(tmpl):
+            state = self._ckptr.restore(path, tmpl)
             # orbax only validates tree STRUCTURE; stale checkpoints from a
             # different flat layout restore silently with on-disk shapes —
             # reject those too
             mismatch = jax.tree.map(
-                lambda a, b: np.shape(a) != np.shape(b), state,
-                host_template)
+                lambda a, b: np.shape(a) != np.shape(b), state, tmpl)
             if any(jax.tree.leaves(mismatch)):
                 raise ValueError("leaf shapes differ from the current "
                                  "state layout")
+            return state
+
+        try:
+            try:
+                state = _restore_checked(host_template)
+            except ValueError:
+                # v0.2 -> v0.3 engine-memory migration: the deferred-mask
+                # state was a keep MASK ('keep_c', 1.0 = keep); it is now a
+                # transmit COUNT ('sent_c', 0.0 = keep). Retry with the
+                # legacy key and convert (sent = 1 - keep) so old runs
+                # resume instead of silently restarting — pending deferred
+                # masks survive the conversion exactly.
+                legacy = self._legacy_keep_template(host_template)
+                if legacy is None:
+                    raise
+                state = _restore_checked(legacy)
+                mem = dict(state.memory)
+                keep = mem.pop("keep_c")
+                mem["sent_c"] = jax.tree.map(lambda k: 1.0 - k, keep)
+                state = state.replace(memory=mem)
+                print(f"[checkpoint] migrated legacy keep_c mask at {path}")
         except ValueError as e:
             # on-disk structure from an older/incompatible state layout
             # (e.g. per-tensor vs flat buffers): train from scratch rather
@@ -151,11 +207,11 @@ class CheckpointManager:
             print(f"[checkpoint] incompatible checkpoint at {path}, "
                   f"ignoring: {str(e).splitlines()[0]}")
             return None
-        meters_path = os.path.join(path, "meters.json")
         meters = {}
         if os.path.exists(meters_path):
             with open(meters_path) as f:
                 meters = json.load(f)
+        meters.pop("_topology", None)
         if best:
             epoch = int(meters.pop("epoch", epoch))
         else:

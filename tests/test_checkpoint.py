@@ -74,3 +74,55 @@ def test_overwrite_same_epoch(tmp_path):
     mgr.save(0, _state(2.0), {})
     state, _, _ = mgr.restore(_state(0.0))
     np.testing.assert_allclose(state.params["w"], 2.0)
+
+
+def test_topology_mismatch_raises_clearly(tmp_path):
+    """A checkpoint written under one process/mesh/tier topology must
+    refuse to restore under another with an explicit error (not an opaque
+    orbax/XLA sharding failure), while matching or absent topology
+    records restore normally."""
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    topo = {"process_count": 1, "world": 8, "num_local_workers": 1}
+    mgr.save(0, _state(1.0), {"m": 1.0}, topology=topo)
+    # same topology: fine, and the record does not leak into meters
+    state, epoch, meters = mgr.restore(_state(0.0), topology=topo)
+    assert "_topology" not in meters
+    # no topology passed (older caller): restores
+    assert mgr.restore(_state(0.0)) is not None
+    # different topology: explicit refusal
+    other = dict(topo, num_local_workers=4)
+    with pytest.raises(RuntimeError, match="topology"):
+        mgr.restore(_state(0.0), topology=other)
+
+
+def test_legacy_keep_mask_checkpoint_migrates(tmp_path):
+    """v0.2 checkpoints carry the deferred-mask state as a keep MASK
+    ('keep_c', 1.0 = keep); v0.3 stores a transmit COUNT ('sent_c',
+    0.0 = keep). Restoring an old checkpoint into the new template must
+    MIGRATE (sent = 1 - keep, pending masks preserved exactly), not
+    silently restart training."""
+
+    def flat_state(mem):
+        return TrainState(step=jnp.zeros((), jnp.int32),
+                          params=jnp.ones((8,)),
+                          opt_state=(jnp.zeros(()),),
+                          memory=mem, batch_stats={})
+
+    keep = jnp.asarray([1., 0., 1., 1., 0., 1., 1., 1.])
+    old = flat_state({"momentums_c": jnp.full((8,), 2.0),
+                      "velocities_c": jnp.full((8,), 3.0),
+                      "keep_c": keep})
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, old, {"m": 1.0})
+
+    new_template = flat_state({"momentums_c": jnp.zeros((8,)),
+                               "velocities_c": jnp.zeros((8,)),
+                               "sent_c": jnp.zeros((8,))})
+    out = mgr.restore(new_template)
+    assert out is not None, "legacy checkpoint must migrate, not restart"
+    state, epoch, _ = out
+    assert "keep_c" not in state.memory
+    np.testing.assert_array_equal(np.asarray(state.memory["sent_c"]),
+                                  1.0 - np.asarray(keep))
+    np.testing.assert_array_equal(np.asarray(state.memory["momentums_c"]),
+                                  2.0)

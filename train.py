@@ -231,10 +231,14 @@ def main():
     state = shard_state(make_flat_state(variables, dist, flat_setup, world),
                         mesh, axis, dist_opt=dist)
 
-    # resume from checkpoint (reference train.py:152-165)
+    # resume from checkpoint (reference train.py:152-165); the topology
+    # record rejects resuming under a different process/mesh/tier setup
+    # with a clear error instead of an opaque orbax sharding failure
+    topology = {"process_count": jax.process_count(), "world": world,
+                "num_local_workers": num_local}
     ckpt = CheckpointManager(ckpt_dir, keep=3)
     last_epoch, best_metric = -1, None
-    restored = ckpt.restore(state, best=args.evaluate) if (
+    restored = ckpt.restore(state, best=args.evaluate, topology=topology) if (
         ckpt.latest_epoch() is not None or args.evaluate) else None
     if restored is not None:
         host_state, last_epoch, meters = restored
@@ -366,7 +370,7 @@ def main():
             printr(f"[{k}] = {v:.2f}")
             writer.add_scalar(k, v, num_inputs)
 
-        path = ckpt.save(epoch, state, meters, best=best)
+        path = ckpt.save(epoch, state, meters, best=best, topology=topology)
         printr(f"[save_path] = {path}")
 
     writer.close()
