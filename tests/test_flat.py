@@ -453,6 +453,50 @@ def test_split_bucket_stratified_selection(monkeypatch):
     assert got == expect
 
 
+def test_3d_layout_free_selection_path(monkeypatch):
+    """Wide buckets (cols >= SEL3D_MIN_COLS) select through the layout-free
+    3-D path (lane-stratified candidates + small final top-k, no 2-D
+    relayout). On CPU both approx stages lower to exact, so the selection
+    must recover nearly all of the exact top-num_selects (lane caps at
+    SEL3D_MARGIN x the mean bind with negligible probability) and the
+    payload invariants hold: indices in-tensor, values = vec[idx], valid
+    count ladder-bounded. The gate is lowered so a CI-sized tensor takes
+    the path (production gates at 3M cols, where the paired A/B says the
+    3-D form wins)."""
+    from dgc_tpu.compression.flat import FlatDGCEngine
+
+    monkeypatch.setattr(FlatDGCEngine, "SEL3D_MIN_COLS", 1024 * 1024)
+    numel = 1_200_000
+    comp = DGCCompressor(0.005, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=0.01)
+    comp.initialize([("w", (numel, (numel,)))])
+    params = {"w": jax.ShapeDtypeStruct((numel,), jnp.float32)}
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+    layout, engine = dist.make_flat(params)
+    [b] = engine.buckets
+    assert engine._use_3d(b), (b.cols, b.strides, b.num_samples)
+
+    a = comp.attributes["w"]
+    rng = np.random.RandomState(17)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:numel] = rng.randn(numel).astype(np.float32)
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                         jax.random.PRNGKey(0))
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    real = idx != layout.sentinel
+    count = int(real.sum())
+    # ladder adaptation guarantees at least lower_bound * num_selects pass
+    # (and the slot cap bounds above)
+    assert 0.8 * a.num_selects * 0.9 <= count <= a.num_selects
+    assert (idx[real] < numel).all() and (idx[real] >= 0).all()
+    np.testing.assert_array_equal(vals[real], vec[idx[real]])
+    assert len(np.unique(idx[real])) == count  # no duplicate coordinates
+    # near-exact recall on CPU (both approx stages lower to exact sorts)
+    exact = set(np.argsort(-np.abs(vec[:numel]))[:count])
+    recall = len(exact & set(idx[real].tolist())) / count
+    assert recall >= 0.95, recall
+
+
 def test_flat_dense_exchange_psum(mesh8):
     params = _params()
     dist = DistributedOptimizer(sgd(0.1), Compression.none(), world_size=W)
