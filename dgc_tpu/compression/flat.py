@@ -764,7 +764,14 @@ class FlatDGCEngine:
                         jnp.broadcast_to(pos[None, :], (Rg, n)), axis=1)
                 else:
                     # nb blocks at block-stride sb spread over the data
-                    # span n*stride (~ the largest row's numel)
+                    # span n*stride (~ the largest row's numel). Reading
+                    # the 4-D view from a layout-free [Rg, cols/128, 128]
+                    # slice of the flat buffer (to skip imp_rows' 2-D
+                    # relayout) was tried and LOST its paired A/B by
+                    # ~0.5 ms/step at ResNet-50 — the slice-of-bitcast
+                    # chain materializes the near-full span instead of
+                    # fusing into the dynamic_slice; the imp_rows read
+                    # below reuses the block selection already paid for.
                     sb = max(1, (n * stride) // (nb * L))
                     phase = jnp.floor(u * sb).astype(jnp.int32)
                     v = imp_rows[r0:r1, :nb * sb * L].reshape(

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Two-tier hierarchical DGC on a multi-host TPU pod: dense full-precision
+# aggregation over each host's ICI-connected chips, sparse DGC exchange
+# over the DCN links between hosts — the REAL form of the reference's
+# "#Sparsified Nodes < #GPUs" regime, which it could only simulate with
+# num_batches_per_step micro-batching (reference README.md:126-128,133-134).
+#
+# num_local_workers must divide the per-host chip count (train.py enforces
+# this) so the dense tier never crosses DCN; on v5e hosts that is 8.
+#
+# Usage:
+#   TPU_NAME=my-pod ZONE=us-central2-b LOCAL=8 ./script/tpu_pod_twotier.sh \
+#       configs/imagenet/resnet50.py configs/dgc/wm0.py [overrides...]
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the TPU pod name}"
+: "${ZONE:?set ZONE to the TPU zone}"
+LOCAL=${LOCAL:-8}
+REPO_DIR=${REPO_DIR:-$(basename "$(cd "$(dirname "$0")/.." && pwd)")}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $REPO_DIR && python train.py --configs $* \
+    --train.num_local_workers $LOCAL"
