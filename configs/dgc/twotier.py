@@ -1,0 +1,14 @@
+"""Two-tier hierarchical exchange flag module (TPU-only; no reference
+counterpart — the reference can only SIMULATE this regime via
+num_batches_per_step, README.md:126-128,133-134).
+
+Dense full-precision aggregation over each group of ``num_local_workers``
+ICI-connected chips, sparse DGC exchange across groups (DCN). The default
+8 matches a v5e host; override per deployment:
+``--train.num_local_workers 4``. train.py requires the value to divide the
+per-process device count on multi-host runs.
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.num_local_workers = 8

@@ -11,6 +11,9 @@ set -euo pipefail
 
 : "${TPU_NAME:?set TPU_NAME to the TPU pod name}"
 : "${ZONE:?set ZONE to the TPU zone}"
+# path of the checkout ON THE POD VMs, relative to the ssh user's home
+# (or absolute); defaults to this repo's directory NAME — set REPO_DIR
+# explicitly when the remote clone lives elsewhere
 REPO_DIR=${REPO_DIR:-$(basename "$(cd "$(dirname "$0")/.." && pwd)")}
 
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \

@@ -16,8 +16,13 @@ set -euo pipefail
 : "${TPU_NAME:?set TPU_NAME to the TPU pod name}"
 : "${ZONE:?set ZONE to the TPU zone}"
 LOCAL=${LOCAL:-8}
+# path of the checkout ON THE POD VMs, relative to the ssh user's home
+# (or absolute); defaults to this repo's directory NAME — set REPO_DIR
+# explicitly when the remote clone lives elsewhere
 REPO_DIR=${REPO_DIR:-$(basename "$(cd "$(dirname "$0")/.." && pwd)")}
 
+# the tier flag goes FIRST: dotted overrides apply last-wins, so a
+# user-supplied --train.num_local_workers in "$@" takes precedence
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
-  --command "cd $REPO_DIR && python train.py --configs $* \
-    --train.num_local_workers $LOCAL"
+  --command "cd $REPO_DIR && python train.py \
+    --train.num_local_workers $LOCAL --configs $*"

@@ -24,7 +24,7 @@ W = 8
 
 
 @pytest.mark.parametrize("flag", ["wm0", "wm5", "wm5o", "fp16", "int32",
-                                  "nm", "mm"])
+                                  "nm", "mm", "twotier"])
 def test_dgc_flag_combo_runs_a_step(mesh8, flag, monkeypatch):
     # fresh global config tree per combo (the CLI process does this by
     # construction; tests must not leak state between combos)
@@ -72,3 +72,8 @@ def test_dgc_flag_combo_runs_a_step(mesh8, flag, monkeypatch):
         assert comp.warmup_epochs == 0 and comp.compress_ratio == 0.001
     if flag in ("wm5", "wm5o"):
         assert comp.compress_ratio > 0.001  # warm-up active at epoch 0
+    if flag == "twotier":
+        # harness-level flag (train.py builds the (hosts, local) mesh and
+        # the hierarchical DistributedOptimizer from it; the exchange
+        # itself is covered by tests/test_hierarchical.py)
+        assert configs.train.num_local_workers == 8
