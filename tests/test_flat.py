@@ -120,6 +120,87 @@ def test_int64_index_wire_path():
         assert FlatDGCEngine(comp2, small).index_dtype == jnp.int64
 
 
+def test_int64_wire_exchange_runs(mesh8):
+    """int32_indices=False on a small model under x64: the WHOLE exchange
+    (compensate, sparsify, gather, scatter-add, sent-count record) runs
+    with int64 wire indices and matches the int32 engine's output exactly
+    (same selections — the index dtype is representation only)."""
+    from dgc_tpu.utils.pytree import named_unflatten
+
+    params = _params()
+    named, treedef = named_flatten(params)
+    rng = np.random.RandomState(21)
+    grads_w = {n: rng.randn(W, *p.shape).astype(np.float32)
+               for n, p in named.items()}
+
+    def build(int32_indices):
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0, int32_indices=int32_indices)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                    world_size=W)
+        layout, engine = dist.make_flat(params)
+        flat_g = jnp.stack([layout.flatten(named_unflatten(
+            {n: jnp.asarray(grads_w[n][w]) for n in named}, treedef))
+            for w in range(W)])
+        mem = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+            engine.init_memory())
+        f = _flat_exchange_fn(None, engine, mesh8)
+        return engine, f(flat_g, mem, jax.random.PRNGKey(0))[0]
+
+    with jax.enable_x64(True):
+        engine64, out64 = build(False)
+        assert engine64.index_dtype == jnp.int64
+        out64 = np.asarray(out64[0])
+    engine32, out32 = build(True)
+    assert engine32.index_dtype == jnp.int32
+    assert np.isfinite(out64).all()
+    np.testing.assert_allclose(out64, np.asarray(out32[0]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_flat_engine_without_error_feedback(mesh8):
+    """DGCCompressor with the no-op base Memory (memory=None): the engine
+    runs sparsify+exchange with NO compensate/masking state (mem == {}),
+    like the reference compressor when paired with the base Memory —
+    output is the scatter-add average of each worker's raw top-k."""
+    params = _params()
+    named, _ = named_flatten(params)
+    comp = DGCCompressor(0.05, sample_ratio=1.0)   # memory=None -> Memory()
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=W)
+    layout, engine = dist.make_flat(params)
+    assert engine.init_memory() == {}
+    rng = np.random.RandomState(23)
+    g = np.zeros((W, layout.total), np.float32)
+    for n in layout.names:
+        o, s = layout.offsets[n], layout.sizes[n]
+        g[:, o:o + s] = rng.randn(W, s)
+
+    def worker(fg, key):
+        out, mem = engine.exchange(fg[0], {}, key, "data", W)
+        assert mem == {}
+        return out[None]
+
+    f = jax.jit(jax.shard_map(
+        worker, mesh=mesh8, in_specs=(P("data"), P()),
+        out_specs=P("data"), check_vma=False))
+    out = np.asarray(f(jnp.asarray(g), jax.random.PRNGKey(0)))[0]
+    assert np.isfinite(out).all()
+    # each worker's top-num_selects contribution averaged; a coordinate
+    # every worker selects equals the plain mean there
+    name = layout.compressed_names[0]
+    o, s = layout.offsets[name], layout.sizes[name]
+    a = comp.attributes[name]
+    per_worker_tops = [set(np.argsort(-np.abs(g[w, o:o + s]))
+                           [:a.num_selects]) for w in range(W)]
+    common = set.intersection(*per_worker_tops)
+    for c in list(common)[:5]:
+        np.testing.assert_allclose(out[o + c], g[:, o + c].mean(),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_layout_mask_vector():
     params = _params()
     layout = ParamLayout(params, [])
