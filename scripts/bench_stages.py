@@ -165,13 +165,13 @@ def main():
     # --- masking + scatter-add decompress ---
     vals0, idx0 = jax.jit(lambda v, k: engine.sparsify(v, k))(gc, key)
 
-    def keep_stage(c):
+    def sent_stage(c):
         vv, acc = c
-        keep = jnp.ones((T,), jnp.float32).at[idx0].set(0.0)
-        return (vv * 0.999, acc + keep[0])
+        sent = jnp.zeros((T,), jnp.float32).at[idx0].add(1.0)
+        return (vv * 0.999, acc + sent[0])
 
-    time_scan(keep_stage, (vc, jnp.float32(0)), args.k, rtt,
-              name="keep-mask scatter (fresh ones)")
+    time_scan(sent_stage, (vc, jnp.float32(0)), args.k, rtt,
+              name="sent-count scatter (fresh zeros)")
 
     def scatter_stage(c):
         acc = jnp.zeros((T,), jnp.float32)
