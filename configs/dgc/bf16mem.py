@@ -1,0 +1,17 @@
+"""Flag module: store the DGC error-feedback state (momentums/velocities)
+in bfloat16.
+
+TPU-native bandwidth option with no reference counterpart (the reference
+keeps fp32 state, /root/reference/dgc/memory.py:47-48): the compensate
+pass is HBM-bandwidth-bound at ImageNet scale and the narrow state halves
+its dominant streams plus every downstream read of the compensated
+gradient (sampling, selection, payload gather). Math still runs in f32
+with one round-to-nearest per stored value; transmitted values are sent
+at bf16 precision and untransmitted residuals keep accumulating in the
+(bf16) velocity. Accuracy validated on the parity task — see
+docs/RESULTS.md.
+"""
+
+from dgc_tpu.utils.config import configs
+
+configs.train.compression.memory.dtype = "bfloat16"

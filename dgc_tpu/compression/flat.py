@@ -47,9 +47,10 @@ from dgc_tpu.utils.pytree import named_flatten, named_unflatten
 __all__ = ["ParamLayout", "FlatDGCEngine", "FlatDenseExchange"]
 
 #: block alignment (elements) of the compressed-block boundary and the buffer
-#: tail — multiples of the Pallas f32 tile (8 x 128) so the kernels see
-#: aligned buffers and need no padding copies on the hot path
-_ALIGN = 8 * 128
+#: tail — multiples of the Pallas tile for BOTH supported state dtypes
+#: (f32: 8 x 128; the opt-in bf16 error-feedback state: 16 x 128) so the
+#: kernels see aligned buffers and need no padding copies on the hot path
+_ALIGN = 16 * 128
 
 
 def _round_up(n: int, align: int) -> int:
@@ -546,8 +547,14 @@ class FlatDGCEngine:
         if self._mem is None:
             return {}
         T, P = self.T, self.layout.total
-        zc = jnp.zeros((T,), self.layout.dtype)
-        zd = jnp.zeros((P - T,), self.layout.dtype)
+        # state dtype: the memory's optional narrow override (bf16 error
+        # feedback — halves the compensate pass's dominant HBM streams and
+        # every downstream read of the compensated gradient), else the
+        # layout dtype. sent_c stays f32 regardless: sub-word scatters
+        # lower to a serial while-loop on v5e (see below).
+        sdt = self._mem.dtype or self.layout.dtype
+        zc = jnp.zeros((T,), sdt)
+        zd = jnp.zeros((P - T,), sdt)
         # masking is DEFERRED: the step that transmits records its
         # transmit COUNTS (sent_c, >0 at transmitted coords — the count
         # rides the decompress scatter-add as one fused [2T] scatter, so
@@ -646,15 +653,20 @@ class FlatDGCEngine:
 
     def _compensate_dense(self, mmt, grad):
         """Non-accumulating correction for the dense-fallback block, applied
-        after averaging (reference compression.py:198, memory.py:64-70)."""
+        after averaging (reference compression.py:198, memory.py:64-70).
+        Math in the gradient dtype; the stored momentum rounds once to the
+        state dtype (no-op unless the bf16 state option is on) — matching
+        ``DGCSGDMemory.compensate(accumulate=False)`` exactly."""
         m = self._mem
         if m is None:
             return grad, mmt
+        sdt = mmt.dtype
+        mmt = mmt.astype(grad.dtype)
         if m.nesterov:
             mmt = (mmt + grad) * m.momentum
-            return mmt + grad, mmt
+            return mmt + grad, mmt.astype(sdt)
         mmt = m.momentum * mmt + grad
-        return mmt, mmt
+        return mmt, mmt.astype(sdt)
 
     # -------------------------------------------------------------- #
     # sparsify (batched per bucket)                                  #

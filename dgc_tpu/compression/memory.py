@@ -68,19 +68,28 @@ class DGCSGDMemory(Memory):
 
     def __init__(self, momentum: float = 0.9, nesterov: bool = False,
                  gradient_clipping: Optional[Callable] = None,
-                 momentum_masking: bool = True):
+                 momentum_masking: bool = True, dtype=None):
         self.momentum = momentum
         self.nesterov = nesterov
         self.gradient_clipping = gradient_clipping
         self.momentum_masking = momentum_masking
+        #: optional state dtype override (e.g. ``'bfloat16'``): the error-
+        #: feedback buffers are stored narrower than the gradient and all
+        #: compensate math runs in the gradient dtype with one
+        #: round-to-nearest per stored value. A TPU-native bandwidth
+        #: option (the compensate pass is HBM-bound at ImageNet scale, see
+        #: docs/RESULTS.md) the reference does not have — it keeps fp32
+        #: state (memory.py:47-48). None keeps the parameter dtype.
+        self.dtype = jnp.dtype(dtype) if dtype is not None else None
 
     def init(self, named_params) -> Dict:
         """Zero (momentum, velocity) buffers for every named parameter,
         flattened to 1-D (reference memory.py:43-48)."""
         momentums, velocities = {}, {}
         for name, p in named_params:
-            momentums[name] = jnp.zeros((p.size,), p.dtype)
-            velocities[name] = jnp.zeros((p.size,), p.dtype)
+            dt = self.dtype or p.dtype
+            momentums[name] = jnp.zeros((p.size,), dt)
+            velocities[name] = jnp.zeros((p.size,), dt)
         return {"momentums": momentums, "velocities": velocities}
 
     def compensate(self, state: Dict, name: str, grad, accumulate: bool = True):
@@ -88,17 +97,22 @@ class DGCSGDMemory(Memory):
         if self.gradient_clipping is not None:
             grad = self.gradient_clipping(grad)
         m = self.momentum
-        mmt = state["momentums"][name]
+        sdt = state["momentums"][name].dtype
+        # math in the gradient dtype; stored state (and the returned
+        # compensated gradient, which IS the stored velocity) round once
+        mmt = state["momentums"][name].astype(grad.dtype)
         if accumulate:
-            vec = state["velocities"][name]
+            vec = state["velocities"][name].astype(grad.dtype)
             if self.nesterov:
                 mmt = (mmt + grad) * m
                 vec = vec + mmt + grad
             else:
                 mmt = m * mmt + grad
                 vec = vec + mmt
+            vec = vec.astype(sdt)
             new_state = {
-                "momentums": {**state["momentums"], name: mmt},
+                "momentums": {**state["momentums"],
+                              name: mmt.astype(sdt)},
                 "velocities": {**state["velocities"], name: vec},
             }
             return vec, new_state
@@ -110,7 +124,8 @@ class DGCSGDMemory(Memory):
                 mmt = m * mmt + grad
                 out = mmt
             new_state = {
-                "momentums": {**state["momentums"], name: mmt},
+                "momentums": {**state["momentums"],
+                              name: mmt.astype(sdt)},
                 "velocities": state["velocities"],
             }
             return out, new_state
@@ -141,6 +156,13 @@ class DGCSGDMemory(Memory):
         velocities = dict(state["velocities"])
         for name in momentums:
             if name in saved["momentums"]:
-                momentums[name] = saved["momentums"][name]
-                velocities[name] = saved["velocities"][name]
+                # cast to the live state dtype: a checkpoint written under
+                # a different memory dtype (fp32 <-> bf16) must not
+                # silently override the configured one (the flat engine's
+                # restore casts the same way)
+                dt = momentums[name].dtype
+                momentums[name] = jnp.asarray(
+                    saved["momentums"][name]).astype(dt)
+                velocities[name] = jnp.asarray(
+                    saved["velocities"][name]).astype(dt)
         return {"momentums": momentums, "velocities": velocities}

@@ -65,26 +65,37 @@ def _interpret() -> bool:
 
 def fused_compensate_reference(grad, mmt, vec, momentum: float,
                                nesterov: bool):
-    """jnp reference (the algorithm contract, reference memory.py:50-63)."""
+    """jnp reference (the algorithm contract, reference memory.py:50-63).
+
+    The state buffers (mmt, vec) may be a NARROWER dtype than the gradient
+    (the opt-in bfloat16 error-feedback state, ``DGCSGDMemory(dtype=...)``):
+    math always runs in the gradient dtype, with exactly one
+    round-to-nearest down-cast per output — when dtypes match the casts
+    are no-ops and the function is bitwise the original."""
+    sdt = mmt.dtype
+    mmt = mmt.astype(grad.dtype)
+    vec = vec.astype(grad.dtype)
     if nesterov:
         mmt = (mmt + grad) * momentum
         vec = vec + mmt + grad
     else:
         mmt = momentum * mmt + grad
         vec = vec + mmt
-    return mmt, vec
+    return mmt.astype(sdt), vec.astype(sdt)
 
 
 def _compensate_kernel(g_ref, m_ref, v_ref, om_ref, ov_ref, *, momentum,
                        nesterov):
     g = g_ref[:]
+    m0 = m_ref[:].astype(g.dtype)
+    v0 = v_ref[:].astype(g.dtype)
     if nesterov:
-        m = (m_ref[:] + g) * momentum
-        ov_ref[:] = v_ref[:] + m + g
+        m = (m0 + g) * momentum
+        ov_ref[:] = (v0 + m + g).astype(ov_ref.dtype)
     else:
-        m = momentum * m_ref[:] + g
-        ov_ref[:] = v_ref[:] + m
-    om_ref[:] = m
+        m = momentum * m0 + g
+        ov_ref[:] = (v0 + m).astype(ov_ref.dtype)
+    om_ref[:] = m.astype(om_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("momentum", "nesterov"))
@@ -93,12 +104,18 @@ def fused_compensate(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
                      ) -> Tuple[jax.Array, jax.Array]:
     """Single-pass ``(mmt', vec')`` over flat [P] buffers.
 
-    Buffers whose length is a multiple of 8*128 (the ``ParamLayout``
-    alignment) run copy-free: reshape to [rows, 128] is a view, the grid's
-    ragged last block is masked by Mosaic. Other lengths (direct callers,
-    tests) pay one pad copy."""
+    Buffers whose length is a multiple of 16*128 (the ``ParamLayout``
+    alignment — 16 sublanes so the optional 2-byte state dtype tiles
+    cleanly too) run copy-free: reshape to [rows, 128] is a view, the
+    grid's ragged last block is masked by Mosaic. Other lengths (direct
+    callers, tests) pay one pad copy. ``mmt``/``vec`` may be a narrower
+    dtype than ``grad`` (bf16 error-feedback state): math runs in the
+    gradient dtype with one rounding per output."""
     n = grad.shape[0]
-    pad = (-n) % (_SUBLANE * _LANE)
+    # any sub-4-byte ref needs the 16-sublane bf16 tile granularity
+    sub = _SUBLANE * (2 if min(grad.dtype.itemsize, mmt.dtype.itemsize,
+                               vec.dtype.itemsize) < 4 else 1)
+    pad = (-n) % (sub * _LANE)
     if pad:
         grad = jnp.concatenate([grad, jnp.zeros((pad,), grad.dtype)])
         mmt = jnp.concatenate([mmt, jnp.zeros((pad,), mmt.dtype)])
@@ -115,8 +132,8 @@ def fused_compensate(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
         functools.partial(_compensate_kernel, momentum=momentum,
                           nesterov=nesterov),
         grid=(grid,),
-        out_shape=(jax.ShapeDtypeStruct(shape2d, grad.dtype),
-                   jax.ShapeDtypeStruct(shape2d, grad.dtype)),
+        out_shape=(jax.ShapeDtypeStruct(shape2d, mmt.dtype),
+                   jax.ShapeDtypeStruct(shape2d, vec.dtype)),
         in_specs=[spec, spec, spec],
         out_specs=(spec, spec),
         interpret=_interpret(),
@@ -142,11 +159,21 @@ def fused_compensate_masked_reference(grad, mmt, vec, sent, momentum: float,
     compensate pass instead of costing its own full-buffer write+read
     (reference order: memory.update zeros transmitted coords, memory.py:
     72-77; the next compensate reads them, memory.py:50-63). ``sent`` is
-    the transmit COUNT vector (0 = keep), see :func:`keep_from_sent`."""
-    kf = keep_from_sent(sent).astype(vec.dtype)
-    m_in = mmt * kf if momentum_masking else mmt
-    return fused_compensate_reference(grad, m_in, vec * kf, momentum,
-                                      nesterov)
+    the transmit COUNT vector (0 = keep), see :func:`keep_from_sent`.
+
+    With a narrower state dtype (bf16 error feedback) the mask multiply
+    runs in the GRADIENT dtype after the up-cast — multiplying by exactly
+    1.0/0.0 is value-preserving either way, so this matches the
+    per-tensor path's ``where(sent, 0, state)`` in state dtype."""
+    sdt = mmt.dtype
+    kf = keep_from_sent(sent).astype(grad.dtype)
+    m_in = mmt.astype(grad.dtype)
+    if momentum_masking:
+        m_in = m_in * kf
+    om, ov = fused_compensate_reference(grad, m_in,
+                                        vec.astype(grad.dtype) * kf,
+                                        momentum, nesterov)
+    return om.astype(sdt), ov.astype(sdt)
 
 
 def _compensate_masked_kernel(g_ref, m_ref, v_ref, k_ref, om_ref, ov_ref, *,
@@ -156,15 +183,17 @@ def _compensate_masked_kernel(g_ref, m_ref, v_ref, k_ref, om_ref, ov_ref, *,
     # scatter lowers to a serial while-loop on v5e, see
     # FlatDGCEngine.init_memory); 0 means keep
     keep = (k_ref[:] == 0).astype(g.dtype)
-    m0 = m_ref[:] * keep if momentum_masking else m_ref[:]
-    v0 = v_ref[:] * keep
+    m0 = m_ref[:].astype(g.dtype)
+    if momentum_masking:
+        m0 = m0 * keep
+    v0 = v_ref[:].astype(g.dtype) * keep
     if nesterov:
         m = (m0 + g) * momentum
-        ov_ref[:] = v0 + m + g
+        ov_ref[:] = (v0 + m + g).astype(ov_ref.dtype)
     else:
         m = momentum * m0 + g
-        ov_ref[:] = v0 + m
-    om_ref[:] = m
+        ov_ref[:] = (v0 + m).astype(ov_ref.dtype)
+    om_ref[:] = m.astype(om_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("momentum", "nesterov",
@@ -180,9 +209,13 @@ def fused_compensate_masked(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
     materialization (measured 0.83 ms/step of full-[T] traffic at
     ResNet-50 scale on v5e). ``sent`` is the transmit-count vector
     (:func:`keep_from_sent`; 0 = keep), f32: sub-word scatters lower to a
-    serial while-loop on v5e."""
+    serial while-loop on v5e. ``mmt``/``vec`` may be a narrower dtype
+    than ``grad`` (bf16 error-feedback state)."""
     n = grad.shape[0]
-    pad = (-n) % (_SUBLANE * _LANE)
+    # any sub-4-byte ref needs the 16-sublane bf16 tile granularity
+    sub = _SUBLANE * (2 if min(grad.dtype.itemsize, mmt.dtype.itemsize,
+                               vec.dtype.itemsize) < 4 else 1)
+    pad = (-n) % (sub * _LANE)
     if pad:
         grad, mmt, vec = (jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
                           for x in (grad, mmt, vec))
@@ -200,8 +233,8 @@ def fused_compensate_masked(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
                           nesterov=nesterov,
                           momentum_masking=momentum_masking),
         grid=(grid,),
-        out_shape=(jax.ShapeDtypeStruct(shape2d, grad.dtype),
-                   jax.ShapeDtypeStruct(shape2d, grad.dtype)),
+        out_shape=(jax.ShapeDtypeStruct(shape2d, mmt.dtype),
+                   jax.ShapeDtypeStruct(shape2d, vec.dtype)),
         in_specs=[spec, spec, spec, spec],
         out_specs=(spec, spec),
         interpret=_interpret(),
@@ -373,7 +406,16 @@ def topk_rows(x: jax.Array, k: int):
     0.238 ms), so the gate is conservative there. Independently of that
     gate, this function self-delegates to ``lax.top_k`` when k exceeds the
     lane width or a row block exceeds the VMEM budget. Non-lane-aligned
-    widths pay one -inf pad copy."""
+    widths pay one -inf pad copy.
+
+    Sub-4-byte inputs (bf16 importance under the bf16 error-feedback
+    state) run through one up-cast to f32: the kernel's 8-sublane tiles
+    and int32 taken-mask carry are f32-shaped, and bf16->f32 is monotone
+    and injective, so ordering, tie-breaking, and the down-cast values
+    are all exact."""
+    if x.dtype.itemsize < 4:
+        v, i = topk_rows(x.astype(jnp.float32), k)
+        return v.astype(x.dtype), i
     R, cols = x.shape
     # k > cols delegates so lax.top_k raises its usual error; k > _LANE
     # exceeds the [8, 128] output block; oversized rows exceed VMEM

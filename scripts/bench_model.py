@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--k", type=int, default=40)
     ap.add_argument("--repeats", type=int, default=8)
     ap.add_argument("--ratio", type=float, default=0.001)
+    ap.add_argument("--mem-dtype", default=None,
+                    help="error-feedback state dtype override, e.g. "
+                         "bfloat16 (configs/dgc/bf16mem.py)")
     args = ap.parse_args()
 
     import bench
@@ -68,7 +71,8 @@ def main():
         return (bench._make_k_loop(step, images, labels, args.k),
                 state), setup
 
-    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(momentum=0.9))
+    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(
+        momentum=0.9, dtype=args.mem_dtype))
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     dgc_run, setup = prepare(DistributedOptimizer(
         dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))

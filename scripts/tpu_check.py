@@ -61,6 +61,34 @@ def check_kernels():
         np.array_equal(np.asarray(cm), np.asarray(rm))
         and np.array_equal(np.asarray(cv), np.asarray(rv)))
 
+    # bf16 error-feedback state (configs/dgc/bf16mem.py): mixed-dtype
+    # blocks (f32 grad/sent, bf16 state) must compile under Mosaic and
+    # match the f32-math-one-rounding reference bitwise. Deliberately
+    # UNALIGNED length: exercises the 16-sublane pad branch the engine's
+    # aligned buffers skip (the one TPU-specific code path CPU pytest
+    # cannot validate).
+    nb = n + 4097
+    gb = jnp.asarray(rng.randn(nb), jnp.float32)
+    sb = jnp.asarray((rng.rand(nb) < 0.001).astype(np.float32))
+    mb = jnp.asarray(rng.randn(nb), jnp.bfloat16)
+    vb = jnp.asarray(rng.randn(nb), jnp.bfloat16)
+    cm, cv = kernels.fused_compensate(gb, mb, vb, 0.9, False)
+    rm, rv = kernels.fused_compensate_reference(gb, mb, vb, 0.9, False)
+    out["fused_compensate_bf16"] = bool(
+        np.array_equal(np.asarray(cm, np.float32),
+                       np.asarray(rm, np.float32))
+        and np.array_equal(np.asarray(cv, np.float32),
+                           np.asarray(rv, np.float32)))
+    cm, cv = kernels.fused_compensate_masked(gb, mb, vb, sb, 0.9, True,
+                                             True)
+    rm, rv = kernels.fused_compensate_masked_reference(
+        gb, mb, vb, sb, 0.9, True, True)
+    out["fused_compensate_masked_bf16"] = bool(
+        np.array_equal(np.asarray(cm, np.float32),
+                       np.asarray(rm, np.float32))
+        and np.array_equal(np.asarray(cv, np.float32),
+                           np.asarray(rv, np.float32)))
+
     # ladder counts at a ResNet-50 bucket shape (rows unpadded: the kernel
     # pads in-trace)
     imp = jnp.asarray(np.abs(rng.randn(17, 262144)).astype(np.float32))

@@ -32,6 +32,18 @@ def main():
     from dgc_tpu.parallel.multihost import (
         host_local_to_global, initialize_multihost, is_coordinator)
 
+    # persistent compilation cache SHARED by both processes (and across
+    # test invocations — a stable tmp location, not the per-test dir): on
+    # a small/loaded host, cold-compiling the train step in both processes
+    # can outlast the coordination service's 300 s shutdown barrier when
+    # one process is starved — the cache removes that variance (warm
+    # runs: ~30 s total)
+    import tempfile
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(tempfile.gettempdir(),
+                                   "dgc_tpu_test_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     os.environ["JAX_COORDINATOR_ADDRESS"] = coord
     os.environ["JAX_NUM_PROCESSES"] = str(num_procs)
     os.environ["JAX_PROCESS_ID"] = str(proc_id)

@@ -315,6 +315,81 @@ def test_flat_matches_per_tensor_exchange(mesh8, nesterov, momentum_masking):
                     err_msg=f"{mkey} step {step} {n}")
 
 
+def test_flat_matches_per_tensor_exchange_bf16_memory(mesh8):
+    """The opt-in bf16 error-feedback state (DGCSGDMemory(dtype='bfloat16'),
+    configs/dgc/bf16mem.py): flat and per-tensor paths round at the same
+    points (f32 math, one round per stored value), so with deterministic
+    selection they must still agree — at bf16 resolution — on exchanged
+    gradients and memory state across steps, and every state buffer must
+    actually BE bf16 on both paths."""
+    params = _params()
+    named, _ = named_flatten(params)
+
+    def make():
+        comp = DGCCompressor(
+            0.05, memory=DGCSGDMemory(momentum=0.9, dtype="bfloat16"),
+            sample_ratio=1.0)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        return comp, DistributedOptimizer(
+            dgc_sgd(0.1, momentum=0.9), comp, world_size=W)
+
+    comp_f, dist_f = make()
+    comp_p, dist_p = make()
+    layout, engine = dist_f.make_flat(params)
+
+    mem0 = engine.init_memory()
+    assert mem0["momentums_c"].dtype == jnp.bfloat16
+    assert mem0["velocities_d"].dtype == jnp.bfloat16
+    assert mem0["sent_c"].dtype == jnp.float32     # scatter stays word-wide
+    mem_p0 = dist_p.init_memory(params)
+    assert all(v.dtype == jnp.bfloat16 for v in mem_p0["momentums"].values())
+
+    rng = np.random.RandomState(3)
+    grads_w = {n: jnp.asarray(rng.randn(W, *p.shape), jnp.float32)
+               for n, p in named.items()}
+
+    flat_fn = _flat_exchange_fn(dist_f, engine, mesh8)
+    pt_fn = _pt_exchange_fn(dist_p, mesh8)
+
+    mem_f = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         mem0)
+    mem_p = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         mem_p0)
+
+    from dgc_tpu.utils.pytree import named_unflatten
+
+    def worker_tree(w):
+        return named_unflatten({n: grads_w[n][w] for n in named},
+                               named_flatten(params)[1])
+
+    flat_grads_w = jnp.stack(
+        [layout.flatten(worker_tree(w)) for w in range(W)])
+
+    for step in range(3):
+        key = jax.random.PRNGKey(step)
+        out_f, mem_f = flat_fn(flat_grads_w, mem_f, key)
+        out_p, mem_p = pt_fn(grads_w, mem_p, key)
+        named_out_p, _ = named_flatten(out_p)
+        named_out_f = layout.unflatten_named(out_f[0])
+        for n in layout.names:
+            np.testing.assert_allclose(
+                np.asarray(named_out_f[n], np.float32).reshape(-1),
+                np.asarray(named_out_p[n][0], np.float32).reshape(-1),
+                rtol=1e-2, atol=1e-2,
+                err_msg=f"exchanged grads step {step} {n}")
+        full_f = _mem_full(engine, jax.tree.map(lambda x: x[0], mem_f))
+        for mkey in ("momentums", "velocities"):
+            assert full_f[mkey].dtype == jnp.bfloat16
+            named_m_f = layout.unflatten_named(
+                jnp.asarray(full_f[mkey]), keep_1d=True)
+            for n in layout.names:
+                np.testing.assert_allclose(
+                    np.asarray(named_m_f[n], np.float32),
+                    np.asarray(mem_p[mkey][n][0], np.float32).reshape(-1),
+                    rtol=1e-2, atol=1e-2,
+                    err_msg=f"{mkey} step {step} {n}")
+
+
 def test_warmup_ratio_rebuild_equivalence(mesh8):
     """The full wm5 warm-up schedule (6 ratio changes, reference
     compression.py:91-107) driven through the FLAT ENGINE REBUILD path:

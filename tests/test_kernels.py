@@ -28,6 +28,57 @@ def test_fused_compensate_matches_reference(n, nesterov):
                                rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.parametrize("nesterov", [False, True])
+@pytest.mark.parametrize("n", [127, 2048, 65536 + 3])
+def test_fused_compensate_bf16_state(n, nesterov):
+    """bf16 error-feedback state: kernel output must match the jnp
+    reference BITWISE (one f32-math pass, one round-to-nearest per stored
+    value — no FMA ambiguity survives the bf16 rounding at these
+    magnitudes), and must equal the all-f32 result after rounding the
+    inputs up/down at the same points."""
+    rng = np.random.RandomState(n)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(n), jnp.bfloat16)
+    om, ov = kernels.fused_compensate(g, m, v, 0.9, nesterov)
+    rm, rv = kernels.fused_compensate_reference(g, m, v, 0.9, nesterov)
+    assert om.dtype == jnp.bfloat16 and ov.dtype == jnp.bfloat16
+    f32 = lambda x: np.asarray(x, np.float32)
+    np.testing.assert_allclose(f32(om), f32(rm), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(f32(ov), f32(rv), rtol=1e-2, atol=1e-2)
+    # the f32-math contract: compute in f32 from the upcast state, round
+    # the outputs once
+    em, ev = kernels.fused_compensate_reference(
+        g, m.astype(jnp.float32), v.astype(jnp.float32), 0.9, nesterov)
+    np.testing.assert_array_equal(f32(rm), f32(em.astype(jnp.bfloat16)))
+    np.testing.assert_array_equal(f32(rv), f32(ev.astype(jnp.bfloat16)))
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_fused_compensate_masked_bf16_state(nesterov):
+    """Masked variant with bf16 state: matches its reference and the
+    eager mask-then-compensate composition at bf16 precision."""
+    n = 2048 + 640
+    rng = np.random.RandomState(5)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(n), jnp.bfloat16)
+    sent = jnp.asarray(rng.rand(n) < 0.3, jnp.float32)
+    om, ov = kernels.fused_compensate_masked(g, m, v, sent, 0.9, nesterov,
+                                             True)
+    rm, rv = kernels.fused_compensate_masked_reference(
+        g, m, v, sent, 0.9, nesterov, True)
+    assert om.dtype == jnp.bfloat16 and ov.dtype == jnp.bfloat16
+    f32 = lambda x: np.asarray(x, np.float32)
+    np.testing.assert_allclose(f32(om), f32(rm), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(f32(ov), f32(rv), rtol=1e-2, atol=1e-2)
+    keep = kernels.keep_from_sent(sent).astype(jnp.bfloat16)
+    em, ev = kernels.fused_compensate_reference(g, m * keep, v * keep,
+                                                0.9, nesterov)
+    np.testing.assert_array_equal(f32(rm), f32(em))
+    np.testing.assert_array_equal(f32(rv), f32(ev))
+
+
 @pytest.mark.parametrize("momentum_masking", [False, True])
 @pytest.mark.parametrize("nesterov", [False, True])
 @pytest.mark.parametrize("n", [127, 1024, 65536 + 3])
