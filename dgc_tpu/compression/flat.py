@@ -578,7 +578,15 @@ class FlatDGCEngine:
         elsewhere (bit-compatible, tests/test_kernels.py). With ``sent``
         (the previous step's transmit counts, 0 = keep), the transmit mask
         (memory.py:72-77) is applied on read inside the same pass
-        (deferred masking)."""
+        (deferred masking).
+
+        With a narrow (bf16) state dtype the compensated gradient is the
+        bf16 velocity and the selection pipeline runs on it directly.
+        (A split-output variant emitting a pre-rounding f32 comp from the
+        same pass was built and measured — it recovered nothing at
+        ResNet-50 (6.53 vs 6.62 ms naive, the bf16 delta lives in the
+        K-loop state carry, not selection) and LOST 4.5 ms/step at VGG;
+        reverted, recorded in docs/RESULTS.md.)"""
         m = self._mem
         if m is None:
             return grad, mmt, vec

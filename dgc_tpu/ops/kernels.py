@@ -409,20 +409,23 @@ def topk_rows(x: jax.Array, k: int):
     widths pay one -inf pad copy.
 
     Sub-4-byte inputs (bf16 importance under the bf16 error-feedback
-    state) run through one up-cast to f32: the kernel's 8-sublane tiles
-    and int32 taken-mask carry are f32-shaped, and bf16->f32 is monotone
-    and injective, so ordering, tie-breaking, and the down-cast values
-    are all exact."""
-    if x.dtype.itemsize < 4:
-        v, i = topk_rows(x.astype(jnp.float32), k)
-        return v.astype(x.dtype), i
+    state) that reach the kernel path run through one up-cast to f32: the
+    kernel's 8-sublane tiles and int32 taken-mask carry are f32-shaped,
+    and bf16->f32 is monotone and injective, so ordering, tie-breaking,
+    and the down-cast values are all exact. The delegation gates are
+    checked FIRST (at f32-equivalent VMEM cost) so a delegating call
+    never pays the up-cast copy — lax.top_k handles bf16 natively."""
     R, cols = x.shape
     # k > cols delegates so lax.top_k raises its usual error; k > _LANE
     # exceeds the [8, 128] output block; oversized rows exceed VMEM
+    # (sized at 4 B/elem: sub-word inputs are up-cast for the kernel)
     if (k > _LANE or k > cols
-            or 8 * _round_up(cols, _LANE) * x.dtype.itemsize
+            or 8 * _round_up(cols, _LANE) * max(x.dtype.itemsize, 4)
             > _TOPK_VMEM_BYTES):
         return jax.lax.top_k(x, k)
+    if x.dtype.itemsize < 4:
+        v, i = topk_rows(x.astype(jnp.float32), k)
+        return v.astype(x.dtype), i
     rpad = (-R) % _SUBLANE
     cpad = (-cols) % _LANE
     if rpad or cpad:

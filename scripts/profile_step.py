@@ -83,6 +83,8 @@ def main():
     ap.add_argument("--ratio", type=float, default=0.001)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--out", default="/tmp/dgc_profile")
+    ap.add_argument("--mem-dtype", default=None,
+                    help="error-feedback state dtype for the dgc arm")
     args = ap.parse_args()
 
     import bench
@@ -117,7 +119,8 @@ def main():
                                 flat=setup)
         return bench._make_k_loop(step, images, labels, args.k), state
 
-    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(momentum=0.9))
+    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(
+        momentum=0.9, dtype=args.mem_dtype))
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     runs = {
         "dgc": prepare(DistributedOptimizer(

@@ -38,10 +38,14 @@ def main():
     # can outlast the coordination service's 300 s shutdown barrier when
     # one process is starved — the cache removes that variance (warm
     # runs: ~30 s total)
+    import getpass
     import tempfile
+    # user-scoped: a shared dir would be unwritable for every user but
+    # its creator on multi-user hosts, silently disabling the cache
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(tempfile.gettempdir(),
-                                   "dgc_tpu_test_jax_cache"))
+                                   f"dgc_tpu_test_jax_cache_"
+                                   f"{getpass.getuser()}"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     os.environ["JAX_COORDINATOR_ADDRESS"] = coord
