@@ -523,6 +523,20 @@ class FlatDGCEngine:
         #: per-worker wire payload in elements — matches the reference's
         #: sum of per-tensor num_selects exactly (compression.py:151)
         self.payload_size = sum(b.payload for b in self.buckets)
+        #: int8 wire (compressor.int8_values): payload position -> tensor
+        #: row (static, payload order = rows in bucket order, num_selects
+        #: entries each) for the per-TENSOR quantization scales; the
+        #: scale wire is one f32 per row — negligible next to the payload
+        self.payload_rows = sum(b.rows for b in self.buckets)
+        if getattr(compressor, "int8_values", False) and self.payload_size:
+            rm, base = [], 0
+            for b in self.buckets:
+                for r, ns in enumerate(b.num_selects):
+                    rm.append(np.full(int(ns), base + r, np.int32))
+                base += b.rows
+            self._row_map = jnp.asarray(np.concatenate(rm))
+        else:
+            self._row_map = None
 
     # -------------------------------------------------------------- #
     # memory (fused over the flat buffers)                           #
@@ -1200,27 +1214,51 @@ class FlatDGCEngine:
             comp = gc
         values, indices = self.sparsify(comp, key)
 
-        wire_values = (values.astype(jnp.float16)
-                       if self.c.fp16_values else values)
-        g_values = jax.lax.all_gather(wire_values, axis_name)  # [W, payload]
-        g_indices = jax.lax.all_gather(indices, axis_name)
-
         dt = flat_grad.dtype
-        # two separate fresh-buffer scatters, deliberately: a single fused
-        # scatter into a [2T] buffer (decompress half + count half) was
-        # measured on v5e and LOSES — the scatter itself costs the same
-        # (0.75 vs 0.75+0.30 ms) but slicing the halves back out
-        # materializes a 0.66 ms loop fusion, a net +0.4 ms/step
-        # (device profile, scripts/profile_step.py). Scatter-set into the
-        # live mmt/vec buffers (1.8 ms) and sub-word masks (serial
-        # while-loop) stay avoided.
-        acc = jnp.zeros((T,), dt).at[g_indices.reshape(-1)].add(
-            g_values.reshape(-1).astype(dt))
+        if self._row_map is not None:
+            # int8 wire: symmetric per-TENSOR quantization (one f32 scale
+            # per row, segment-max over the tight payload) — the
+            # reference's stated "no quantization/encoding of payloads"
+            # caveat (README.md:130-138) addressed; dequantize after the
+            # gather, before the scatter-add
+            smax = jax.ops.segment_max(jnp.abs(values), self._row_map,
+                                       num_segments=self.payload_rows)
+            scale = (smax / 127.0).astype(jnp.float32)
+            safe = jnp.where(scale > 0, scale, 1.0)
+            q = jnp.clip(jnp.round(values / safe[self._row_map]),
+                         -127, 127).astype(jnp.int8)
+            g_q = jax.lax.all_gather(q, axis_name)          # [W, payload]
+            g_scales = jax.lax.all_gather(scale, axis_name)  # [W, rows]
+            g_values = g_q.astype(dt) * jnp.take(
+                g_scales.astype(dt), self._row_map, axis=1)
+        else:
+            wire_values = (values.astype(jnp.float16)
+                           if self.c.fp16_values else values)
+            g_values = jax.lax.all_gather(wire_values,
+                                          axis_name)        # [W, payload]
+        g_indices = jax.lax.all_gather(indices, axis_name)
+        # Averaging divides the [W, payload] WIRE values BEFORE the
+        # scatter (algebraically identical to the reference's
+        # scatter-then-divide, compression.py:192-193; differs by
+        # float-rounding order only): the full-[T] divide pass disappears
+        # — its read/write cost scales with the model, ~0.8 ms/step at
+        # VGG. The scatter keeps a fresh ZEROS operand + concat,
+        # deliberately: XLA fuses the zero-init INTO the scatter (one [T]
+        # write), while scattering into a non-zero operand (the final [P]
+        # buffer pre-filled with the dense tail — tried both as a
+        # trailing dynamic_update_slice and as a concat-initialized
+        # operand) always COPIES the operand and measured +0.3 ms/step at
+        # ResNet-50. The fused [2T] acc+sent scatter also LOSES (slicing
+        # the halves back out materializes a 0.66 ms loop fusion);
+        # scatter-set into the live mmt/vec buffers (1.8 ms) and sub-word
+        # masks (serial while-loop) stay avoided.
+        wire = g_values.reshape(-1).astype(dt)
+        if op == "average":
+            wire = wire / world_size
+        acc = jnp.zeros((T,), dt).at[g_indices.reshape(-1)].add(wire)
         if m is not None:
             # THIS step's transmit-count record for the next compensate
             new_sent = jnp.zeros((T,), dt).at[indices].add(1.0)
-        # /world_size only under Average (compression.py:192-193)
-        out_c = acc / world_size if op == "average" else acc
 
         # --- dense fallback block: one collective + correction ---
         if P > T:
@@ -1230,9 +1268,9 @@ class FlatDGCEngine:
                 # (reference compression.py:198 -> memory.py:52-53)
                 gd_avg = self._clip_block(gd_avg, self.layout.dense_names, T)
             out_d, md = self._compensate_dense(md, gd_avg)
-            out = jnp.concatenate([out_c, out_d])
+            out = jnp.concatenate([acc, out_d])
         else:
-            out = out_c
+            out = acc
 
         if m is not None:
             mem = {"momentums_c": mc, "velocities_c": vc,

@@ -98,6 +98,7 @@ def build_arm(arm, variables, lr_sched, world, ratio, warmup_epochs, args):
         comp = DGCCompressor(
             ratio, memory=DGCSGDMemory(momentum=0.9, dtype=mem_dtype),
             warmup_epochs=warmup_epochs,
+            int8_values=(arm == "dgc_int8"),
             approx_recall=recall)
         from dgc_tpu.utils.pytree import named_flatten
         named, _ = named_flatten(variables["params"])

@@ -31,6 +31,18 @@ def main():
     ap.add_argument("--mem-dtype", default=None,
                     help="error-feedback state dtype override, e.g. "
                          "bfloat16 (configs/dgc/bf16mem.py)")
+    ap.add_argument("--int8", action="store_true",
+                    help="int8-quantized wire values (configs/dgc/int8.py)")
+    ap.add_argument("--mode", default="scan", choices=["scan", "dispatch"],
+                    help="scan: K steps in one lax.scan dispatch (the "
+                         "conservative default — its while-loop carry "
+                         "copies the big DGC state each iteration, ~1 "
+                         "ms/step counted against DGC). dispatch: K "
+                         "DONATED per-dispatch steps queued async + one "
+                         "readback — how real training runs; valid only "
+                         "while the relay's per-call dispatch latency "
+                         "stays under the step time (watch the paired "
+                         "MAD).")
     args = ap.parse_args()
 
     import bench
@@ -61,18 +73,29 @@ def main():
                    train=True)
     named, _ = named_flatten(v["params"])
 
+    dispatch = args.mode == "dispatch"
+
+    def make_dispatch_loop(step_fn, k):
+        def run(state, key):
+            keys = jax.random.split(key, k)
+            for i in range(k):
+                state, m = step_fn(state, images, labels, keys[i])
+            return state, m["loss"]
+        return run
+
     def prepare(dist):
         setup = make_flat_setup(v, dist)
         state = shard_state(make_flat_state(v, dist, setup, W), mesh,
                             dist_opt=dist)
-        step = build_train_step(model.apply, dist, mesh, donate=False,
+        step = build_train_step(model.apply, dist, mesh, donate=dispatch,
                                 use_dropout="vgg" in args.model,
                                 flat=setup)
-        return (bench._make_k_loop(step, images, labels, args.k),
-                state), setup
+        loop = (make_dispatch_loop(step, args.k) if dispatch
+                else bench._make_k_loop(step, images, labels, args.k))
+        return (loop, state), setup
 
     comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(
-        momentum=0.9, dtype=args.mem_dtype))
+        momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8)
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     dgc_run, setup = prepare(DistributedOptimizer(
         dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))

@@ -24,7 +24,8 @@ W = 8
 
 
 @pytest.mark.parametrize("flag", ["wm0", "wm5", "wm5o", "fp16", "int32",
-                                  "nm", "mm", "twotier", "bf16mem"])
+                                  "nm", "mm", "twotier", "bf16mem",
+                                  "int8"])
 def test_dgc_flag_combo_runs_a_step(mesh8, flag, monkeypatch):
     # fresh global config tree per combo (the CLI process does this by
     # construction; tests must not leak state between combos)

@@ -390,6 +390,82 @@ def test_flat_matches_per_tensor_exchange_bf16_memory(mesh8):
                     err_msg=f"{mkey} step {step} {n}")
 
 
+def test_flat_matches_per_tensor_exchange_int8_wire(mesh8):
+    """int8 wire values (DGCCompressor(int8_values=True),
+    configs/dgc/int8.py): both paths quantize per tensor with the same
+    symmetric scale (max|payload|/127, round-to-nearest), so flat and
+    per-tensor exchanges must produce identical dequantized gradients,
+    and the dequantization error of each transmitted value is bounded by
+    scale/2."""
+    params = _params()
+    named, _ = named_flatten(params)
+
+    def make():
+        comp = DGCCompressor(
+            0.05, memory=DGCSGDMemory(momentum=0.9), sample_ratio=1.0,
+            int8_values=True)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        return comp, DistributedOptimizer(
+            dgc_sgd(0.1, momentum=0.9), comp, world_size=W)
+
+    comp_f, dist_f = make()
+    comp_p, dist_p = make()
+    layout, engine = dist_f.make_flat(params)
+    assert engine._row_map is not None
+    assert int(engine._row_map.shape[0]) == engine.payload_size
+
+    rng = np.random.RandomState(5)
+    grads_w = {n: jnp.asarray(rng.randn(W, *p.shape), jnp.float32)
+               for n, p in named.items()}
+
+    flat_fn = _flat_exchange_fn(dist_f, engine, mesh8)
+    pt_fn = _pt_exchange_fn(dist_p, mesh8)
+    mem_f = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         engine.init_memory())
+    mem_p = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+                         dist_p.init_memory(params))
+
+    from dgc_tpu.utils.pytree import named_unflatten
+
+    def worker_tree(w):
+        return named_unflatten({n: grads_w[n][w] for n in named},
+                               named_flatten(params)[1])
+
+    flat_grads_w = jnp.stack(
+        [layout.flatten(worker_tree(w)) for w in range(W)])
+
+    for step in range(3):
+        key = jax.random.PRNGKey(step)
+        out_f, mem_f = flat_fn(flat_grads_w, mem_f, key)
+        out_p, mem_p = pt_fn(grads_w, mem_p, key)
+        named_out_p, _ = named_flatten(out_p)
+        named_out_f = layout.unflatten_named(out_f[0])
+        for n in layout.names:
+            np.testing.assert_allclose(
+                np.asarray(named_out_f[n]).reshape(-1),
+                np.asarray(named_out_p[n][0]).reshape(-1),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"exchanged grads step {step} {n}")
+
+
+def test_int8_quantization_roundtrip_bound():
+    """quantize_int8: dequantized values are within scale/2 of the
+    original, zero maps to zero, and an all-zero vector survives."""
+    from dgc_tpu.compression.dgc import quantize_int8
+    rng = np.random.RandomState(0)
+    v = jnp.asarray(rng.randn(1000) * np.exp(rng.randn(1000) * 3),
+                    jnp.float32)
+    q, scale = quantize_int8(v)
+    assert q.dtype == jnp.int8 and scale.dtype == jnp.float32
+    deq = np.asarray(q, np.float32) * float(scale)
+    err = np.abs(deq - np.asarray(v))
+    assert err.max() <= float(scale) / 2 + 1e-7
+    assert float(scale) == pytest.approx(
+        float(jnp.max(jnp.abs(v))) / 127.0)
+    qz, sz = quantize_int8(jnp.zeros((16,), jnp.float32))
+    assert float(sz) == 0.0 and not np.asarray(qz).any()
+
+
 def test_warmup_ratio_rebuild_equivalence(mesh8):
     """The full wm5 warm-up schedule (6 ratio changes, reference
     compression.py:91-107) driven through the FLAT ENGINE REBUILD path:
