@@ -570,29 +570,34 @@ class FlatDGCEngine:
         zc = jnp.zeros((T,), sdt)
         zd = jnp.zeros((P - T,), sdt)
         # masking is DEFERRED: the step that transmits records its
-        # transmit COUNTS (sent_c, >0 at transmitted coords — the count
-        # rides the decompress scatter-add as one fused [2T] scatter, so
-        # the record costs no extra scatter); the NEXT step's compensate
-        # applies the zeroing on read, fused into the Pallas kernel
-        # (kernels.fused_compensate_masked) — bitwise identical to eager
-        # masking but it rides the compensate pass instead of costing its
-        # own full-[T] write+read (measured 0.83 ms/step at ResNet-50
-        # scale on v5e). The [T] f32 shape is ratio-independent, so
-        # checkpoints survive warm-up ratio changes. f32 deliberately: a
-        # sub-word (int8) mask would quarter the read bandwidth but its
-        # SCATTER lowers to a serial while-loop on v5e (~2.3 ms/step
-        # measured).
+        # transmitted coordinates, and the NEXT step's compensate applies
+        # the zeroing on read, fused into the Pallas kernel — bitwise
+        # identical to eager masking but it rides the compensate pass
+        # instead of costing its own full-[T] write+read (measured
+        # 0.83 ms/step at ResNet-50 scale on v5e). The record is
+        # BIT-PACKED (sent_bits, kernels.pack_sent_bits — one int32 word
+        # per 32 coordinates): per-worker payload indices are unique, so
+        # one word-wide scatter of single bits replaces the v0.3 full-[T]
+        # f32 count vector — 32x less HBM on the kernel's mask stream,
+        # the per-step zero-init, and the state carried between steps.
+        # (An int8 byte mask was rejected earlier for its sub-word
+        # scatter, which lowers to a serial while-loop on v5e; the
+        # word-wide bit scatter has no such problem.) The record's shape
+        # is ratio-independent, so checkpoints survive warm-up ratio
+        # changes.
         return {"momentums_c": zc, "velocities_c": zc,
                 "momentums_d": zd, "velocities_d": zd,
-                "sent_c": jnp.zeros((T,), self.layout.dtype)}
+                "sent_bits": jnp.zeros((kernels.num_sent_words(T) if T else 0,),
+                                       jnp.int32)}
 
-    def _compensate_acc(self, mmt, vec, grad, sent=None):
+    def _compensate_acc(self, mmt, vec, grad, sent_bits=None):
         """Momentum correction + local accumulation (memory.py:50-63) —
         the fused single-pass Pallas kernel on TPU, its jnp reference
-        elsewhere (bit-compatible, tests/test_kernels.py). With ``sent``
-        (the previous step's transmit counts, 0 = keep), the transmit mask
-        (memory.py:72-77) is applied on read inside the same pass
-        (deferred masking).
+        elsewhere (bit-compatible, tests/test_kernels.py). With
+        ``sent_bits`` (the previous step's bit-packed transmit record,
+        kernels.pack_sent_bits), the transmit mask (memory.py:72-77) is
+        applied on read inside the same pass (deferred masking), expanded
+        from the packed words in VMEM.
 
         With a narrow (bf16) state dtype the compensated gradient is the
         bf16 velocity and the selection pipeline runs on it directly.
@@ -604,14 +609,14 @@ class FlatDGCEngine:
         m = self._mem
         if m is None:
             return grad, mmt, vec
-        if sent is not None:
+        if sent_bits is not None:
             if kernels.use_pallas() and grad.shape[0] > 0:
-                mmt, vec = kernels.fused_compensate_masked(
-                    grad, mmt, vec, sent, m.momentum, m.nesterov,
+                mmt, vec = kernels.fused_compensate_bits(
+                    grad, mmt, vec, sent_bits, m.momentum, m.nesterov,
                     m.momentum_masking)
             else:
-                mmt, vec = kernels.fused_compensate_masked_reference(
-                    grad, mmt, vec, sent, m.momentum, m.nesterov,
+                mmt, vec = kernels.fused_compensate_bits_reference(
+                    grad, mmt, vec, sent_bits, m.momentum, m.nesterov,
                     m.momentum_masking)
         elif kernels.use_pallas() and grad.shape[0] > 0:
             mmt, vec = kernels.fused_compensate(grad, mmt, vec, m.momentum,
@@ -1177,9 +1182,9 @@ class FlatDGCEngine:
             # memory.py:72-77), and reset it — carrying it forward would
             # wrongly zero the dense momentum written below
             mc, vc = mem["momentums_c"], mem["velocities_c"]
-            sent = mem.get("sent_c")
-            if m is not None and T and sent is not None:
-                keep = kernels.keep_from_sent(sent).astype(vc.dtype)
+            bits = mem.get("sent_bits")
+            if m is not None and T and bits is not None:
+                keep = kernels.keep_from_bits(bits, T).astype(vc.dtype)
                 vc = vc * keep
                 if m.momentum_masking:
                     mc = mc * keep
@@ -1190,7 +1195,9 @@ class FlatDGCEngine:
             return out, {"momentums_c": mc2, "momentums_d": md2,
                          "velocities_c": vc,
                          "velocities_d": mem["velocities_d"],
-                         "sent_c": jnp.zeros((T,), self.layout.dtype)}
+                         "sent_bits": jnp.zeros(
+                             (kernels.num_sent_words(T) if T else 0,),
+                             jnp.int32)}
 
         gc, gd = flat_grad[:T], flat_grad[T:]
         if m is not None:
@@ -1206,10 +1213,11 @@ class FlatDGCEngine:
                 # compensate (reference memory.py:52-53)
                 gc = self._clip_block(gc, self.layout.compressed_names, 0)
             # deferred masking (memory.py:72-77): the PREVIOUS step's
-            # transmit counts are applied on read inside the compensate
+            # transmit record is applied on read inside the compensate
             # pass. x*0 == set-to-0 for finite values, and the sentinel
             # slot is a structural zero, so padded payload slots are no-ops.
-            comp, mc, vc = self._compensate_acc(mc, vc, gc, mem["sent_c"])
+            comp, mc, vc = self._compensate_acc(mc, vc, gc,
+                                                mem["sent_bits"])
         else:
             comp = gc
         values, indices = self.sparsify(comp, key)
@@ -1257,8 +1265,12 @@ class FlatDGCEngine:
             wire = wire / world_size
         acc = jnp.zeros((T,), dt).at[g_indices.reshape(-1)].add(wire)
         if m is not None:
-            # THIS step's transmit-count record for the next compensate
-            new_sent = jnp.zeros((T,), dt).at[indices].add(1.0)
+            # THIS step's transmit record for the next compensate:
+            # bit-packed, one word-wide scatter over a 32x smaller buffer
+            # (padded slots carry the sentinel and are dropped — their
+            # repeated single-bit adds would carry across bits)
+            new_bits = kernels.pack_sent_bits(
+                indices, T, sentinel=self.layout.sentinel)
 
         # --- dense fallback block: one collective + correction ---
         if P > T:
@@ -1275,7 +1287,7 @@ class FlatDGCEngine:
         if m is not None:
             mem = {"momentums_c": mc, "velocities_c": vc,
                    "momentums_d": md, "velocities_d": mem["velocities_d"],
-                   "sent_c": new_sent}
+                   "sent_bits": new_bits}
         return out, mem
 
     # -------------------------------------------------------------- #
@@ -1286,13 +1298,14 @@ class FlatDGCEngine:
         """Split memory -> canonical {momentums: [P], velocities: [P]}
         view, with any pending (deferred) transmit mask materialized —
         checkpoint/inspection time only, the hot path never builds it.
-        The sent-count vector is ratio-independent ([T] never changes), so
-        a pending mask survives warm-up engine rebuilds untouched — the
-        next compensate applies it identically."""
+        The packed transmit record is ratio-independent (its word count
+        never changes), so a pending mask survives warm-up engine rebuilds
+        untouched — the next compensate applies it identically."""
         mc, vc = mem["momentums_c"], mem["velocities_c"]
         m = self._mem
         if m is not None and mc.shape[0] > 0:
-            keep = kernels.keep_from_sent(mem["sent_c"]).astype(vc.dtype)
+            keep = kernels.keep_from_bits(mem["sent_bits"],
+                                          mc.shape[0]).astype(vc.dtype)
             vc = vc * keep
             if m.momentum_masking:
                 mc = mc * keep
@@ -1333,7 +1346,8 @@ class FlatDGCEngine:
             out[key + "_c"] = flat[:T]
             out[key + "_d"] = flat[T:]
         # loaded buffers are canonical (already masked): nothing pending
-        out["sent_c"] = jnp.zeros((T,), self.layout.dtype)
+        out["sent_bits"] = jnp.zeros((kernels.num_sent_words(T) if T
+                                      else 0,), jnp.int32)
         return out
 
 

@@ -36,7 +36,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_compensate", "fused_compensate_reference",
            "fused_compensate_masked", "fused_compensate_masked_reference",
-           "keep_from_sent",
+           "fused_compensate_bits", "fused_compensate_bits_reference",
+           "keep_from_sent", "pack_sent_bits", "keep_from_bits",
+           "num_sent_words",
            "ladder_counts", "ladder_counts_reference",
            "topk_rows", "topk_rows_reference", "use_pallas"]
 
@@ -144,10 +146,10 @@ def fused_compensate(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
 
 def keep_from_sent(sent):
     """Transmit-count -> multiplicative keep mask: 1.0 where the coordinate
-    was NOT transmitted last step (count 0), else 0.0. The engine records
-    counts, not masks, so the record rides the decompress scatter-add
-    (one fused [2T] scatter) instead of a second scatter into a ones
-    buffer; this conversion runs INSIDE the compensate pass."""
+    was NOT transmitted last step (count 0), else 0.0. Used by the v0.3
+    full-[T] count-vector record (:func:`fused_compensate_masked`, kept
+    as the tested building block); the engine now ships the bit-packed
+    record (:func:`pack_sent_bits` / :func:`fused_compensate_bits`)."""
     return (sent == 0).astype(sent.dtype)
 
 
@@ -239,6 +241,181 @@ def fused_compensate_masked(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
         out_specs=(spec, spec),
         interpret=_interpret(),
     )(g2, m2, v2, k2)
+    om, ov = om.reshape(-1), ov.reshape(-1)
+    return (om[:n], ov[:n]) if pad else (om, ov)
+
+
+# ------------------------------------------------------------------ #
+# bit-packed transmit record                                         #
+# ------------------------------------------------------------------ #
+
+#: flat elements covered by one 128-lane row of packed words: 32 rows of
+#: the [rows, 128] f32 view share one word row (bit = row % 32)
+_BITS_GROUP = 32 * _LANE
+
+
+def num_sent_words(total: int) -> int:
+    """Length of the packed transmit record for a [total] buffer:
+    ceil(total / 4096) * 128 int32 words (total must be lane-aligned;
+    the layout's _ALIGN guarantees it). When total % 4096 == 2048 the
+    last word group covers 16 real rows only — the phantom rows' bits
+    are simply never set, so they read keep=1."""
+    assert total % _LANE == 0, total  # engine T is _ALIGN-aligned
+    return -(-total // _BITS_GROUP) * _LANE
+
+
+def pack_sent_bits(indices: jax.Array, total: int,
+                   sentinel=None) -> jax.Array:
+    """Transmit indices -> packed one-bit-per-coordinate record.
+
+    Word layout matches the compensate kernel's in-VMEM expansion: flat
+    position p (of the [rows, 128] row-major view: row = p // 128,
+    lane = p % 128) maps to word ``(p // 4096) * 128 + (p % 128)``, bit
+    ``(p // 128) % 32`` — i.e. word (a, l) of the [W // 128, 128] word
+    view holds rows a*32 .. a*32+31 of lane l. The record replaces the
+    v0.3 full-[T] f32 count vector: 32x less HBM on the compensate
+    kernel's mask stream, the per-step zero-init, and the state carried
+    between steps (docs/RESULTS.md lists the measured costs).
+
+    ``indices`` must be unique apart from ``sentinel`` entries (padded
+    payload slots), which are dropped — the engine's fixed-size selection
+    guarantees this (distinct per-row top-k positions, disjoint rows);
+    duplicate REAL indices would carry into a neighboring row's bit,
+    unlike the old count vector which tolerated them.
+    """
+    W = num_sent_words(total)
+    # W must fit int32 for the scatter (total < 2**36 slots = 256 GiB of
+    # f32 parameters — beyond any current HBM; the int64-wire layouts
+    # stay far under this)
+    assert W < 2 ** 31, total
+    idx = indices
+    w = (idx >> 12) * 128 + (idx & 127)
+    bit = ((idx >> 7) & 31).astype(jnp.int32)
+    if sentinel is not None:
+        # padded slots all carry the sentinel index: their repeated adds
+        # would carry across bits, so route them out of bounds and drop
+        w = jnp.where(idx == sentinel, W, w)
+    return jnp.zeros((W,), jnp.int32).at[w.astype(jnp.int32)].add(
+        jnp.left_shift(jnp.int32(1), bit), mode="drop")
+
+
+def keep_from_bits(bits: jax.Array, total: int) -> jax.Array:
+    """Packed transmit record -> multiplicative keep mask [total] (1.0 =
+    not transmitted). jnp reference of the kernel's in-VMEM expansion;
+    used off the hot path (checkpoint materialization, the dense-branch
+    pending-mask flush)."""
+    W = bits.shape[0]
+    assert W == num_sent_words(total), (W, total)
+    b3 = bits.reshape(-1, 1, _LANE)                       # [A, 1, 128]
+    m = jnp.arange(32, dtype=jnp.int32)[None, :, None]    # [1, 32, 1]
+    keep = (jnp.right_shift(b3, m) & 1) == 0              # [A, 32, 128]
+    return keep.reshape(-1)[:total].astype(jnp.float32)
+
+
+def fused_compensate_bits_reference(grad, mmt, vec, bits, momentum: float,
+                                    nesterov: bool, momentum_masking: bool):
+    """jnp reference: unpack the bit record to a keep mask, then compensate
+    — the mask multiply runs in the GRADIENT dtype exactly like
+    :func:`fused_compensate_masked_reference` (multiplying by 1.0/0.0 is
+    value-preserving in any dtype, so this is bitwise the per-tensor
+    path's eager ``where(sent, 0, state)``)."""
+    sdt = mmt.dtype
+    kf = keep_from_bits(bits, grad.shape[0]).astype(grad.dtype)
+    m_in = mmt.astype(grad.dtype)
+    if momentum_masking:
+        m_in = m_in * kf
+    om, ov = fused_compensate_reference(grad, m_in,
+                                        vec.astype(grad.dtype) * kf,
+                                        momentum, nesterov)
+    return om.astype(sdt), ov.astype(sdt)
+
+
+def _compensate_bits_kernel(g_ref, m_ref, v_ref, b_ref, om_ref, ov_ref, *,
+                            momentum, nesterov, momentum_masking):
+    g = g_ref[:]
+    rows = g.shape[0]
+    b = b_ref[:]                                          # [rows//32, 128]
+    # in-VMEM bit expansion: word (a, l) -> rows a*32..a*32+31 of lane l.
+    # The broadcast+reshape is sublane-local (the lane dim never moves),
+    # which Mosaic legalizes; a jnp.repeat formulation and a 4-way-where
+    # word select over a [rows, 4] word layout both failed to lower
+    # (docs/RESULTS.md round-3 negative results).
+    exp = jnp.broadcast_to(b[:, None, :], (rows // 32, 32, _LANE)).reshape(
+        rows, _LANE)
+    r = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANE), 0)
+    keep = (((exp >> (r & 31)) & 1) == 0).astype(g.dtype)
+    m0 = m_ref[:].astype(g.dtype)
+    if momentum_masking:
+        m0 = m0 * keep
+    v0 = v_ref[:].astype(g.dtype) * keep
+    if nesterov:
+        m = (m0 + g) * momentum
+        ov_ref[:] = (v0 + m + g).astype(ov_ref.dtype)
+    else:
+        m = momentum * m0 + g
+        ov_ref[:] = (v0 + m).astype(ov_ref.dtype)
+    om_ref[:] = m.astype(om_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("momentum", "nesterov",
+                                             "momentum_masking"))
+def fused_compensate_bits(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
+                          bits: jax.Array, momentum: float,
+                          nesterov: bool = False,
+                          momentum_masking: bool = True
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Single-pass mask-on-read + compensate with the transmit record
+    bit-PACKED: reads (grad, mmt, vec) plus a 32x-smaller int32 word
+    stream instead of the f32 count vector of
+    :func:`fused_compensate_masked` — the expansion happens in VMEM
+    (measured bitwise-equal and slightly faster on v5e; the real win is
+    the removed [T] zero-init + scatter and the 32x smaller carried
+    state, scripts/proto_bitpack.py). ``bits`` must come from
+    :func:`pack_sent_bits` (same word layout). ``mmt``/``vec`` may be a
+    narrower dtype than ``grad`` (bf16 error-feedback state).
+
+    Alignment: the data buffers pad only to the usual sublane tile (like
+    the other compensate kernels) — NOT to the 4096-element word group.
+    The engine's T is frequently ``≡ 2048 (mod 4096)`` (the _ALIGN
+    granularity), and padding there would copy all three [T] streams
+    every step (~1 ms at ResNet-50, ~5 ms at VGG — the first integration
+    measured exactly that regression). Instead the grid's ragged last
+    block is masked by Mosaic; the word array always covers
+    ``ceil(n / 4096)`` groups, so half-group tails read bits that are
+    never set (keep)."""
+    n = grad.shape[0]
+    assert bits.shape[0] == num_sent_words(n), (bits.shape, n)
+    # any sub-4-byte ref needs the 16-sublane bf16 tile granularity
+    sub = _SUBLANE * (2 if min(grad.dtype.itemsize, mmt.dtype.itemsize,
+                               vec.dtype.itemsize) < 4 else 1)
+    pad = (-n) % (sub * _LANE)
+    if pad:
+        grad, mmt, vec = (jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+                          for x in (grad, mmt, vec))
+    rows = (n + pad) // _LANE
+    shape2d = (rows, _LANE)
+    g2, m2, v2 = (x.reshape(shape2d) for x in (grad, mmt, vec))
+    b2 = bits.reshape(-1, _LANE)       # [ceil(n/4096), 128] word groups
+
+    # the in-kernel expansion needs a whole number of 32-row word groups
+    # per block; a block may overhang the array (ragged masking)
+    block_rows = min(_CHUNK_ROWS, _round_up(rows, 32))
+    grid = pl.cdiv(rows, block_rows)
+    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((block_rows // 32, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    om, ov = pl.pallas_call(
+        functools.partial(_compensate_bits_kernel, momentum=momentum,
+                          nesterov=nesterov,
+                          momentum_masking=momentum_masking),
+        grid=(grid,),
+        out_shape=(jax.ShapeDtypeStruct(shape2d, mmt.dtype),
+                   jax.ShapeDtypeStruct(shape2d, vec.dtype)),
+        in_specs=[spec, spec, spec, bspec],
+        out_specs=(spec, spec),
+        interpret=_interpret(),
+    )(g2, m2, v2, b2)
     om, ov = om.reshape(-1), ov.reshape(-1)
     return (om[:n], ov[:n]) if pad else (om, ov)
 

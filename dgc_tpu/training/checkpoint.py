@@ -102,16 +102,43 @@ class CheckpointManager:
     # ------------------------------------------------------------------ #
 
     @staticmethod
-    def _legacy_keep_template(template):
-        """Template with the flat engine's 'sent_c' memory key renamed to
-        the v0.2 'keep_c' — None when the state carries no such key (the
-        migration only applies to flat-engine DGC states)."""
+    def _legacy_sent_template(template, key: str):
+        """Template with the flat engine's v0.4 'sent_bits' packed record
+        (int32 words) replaced by the legacy full-[T] f32 vector under
+        ``key`` — 'sent_c' (v0.3 transmit counts) or 'keep_c' (v0.2 keep
+        mask). None when the state carries no packed record (the
+        migration only applies to flat-engine DGC states). T comes from
+        the momentum buffer (the word count is not invertible when
+        T % 4096 == 2048)."""
         mem = getattr(template, "memory", None)
-        if not (isinstance(mem, dict) and "sent_c" in mem):
+        if not (isinstance(mem, dict) and "sent_bits" in mem
+                and "momentums_c" in mem):
             return None
         legacy = dict(mem)
-        legacy["keep_c"] = legacy.pop("sent_c")
+        bits = legacy.pop("sent_bits")
+        mc = legacy["momentums_c"]
+        shape = tuple(np.shape(bits)[:-1]) + (np.shape(mc)[-1],)
+        legacy[key] = np.zeros(shape, np.float32)
         return template.replace(memory=legacy)
+
+    @staticmethod
+    def _pack_transmitted_np(transmitted: np.ndarray) -> np.ndarray:
+        """Bool [..., T] transmitted map -> the engine's packed int32 word
+        record [..., W] (kernels.pack_sent_bits layout): word
+        (a, l) of each trailing [A, 128] word view holds rows
+        a*32 .. a*32+31 of lane l of the [T // 128, 128] row view."""
+        T = transmitted.shape[-1]
+        pad = (-T) % 4096
+        if pad:
+            z = np.zeros(transmitted.shape[:-1] + (pad,), bool)
+            transmitted = np.concatenate([transmitted, z], axis=-1)
+        s3 = transmitted.reshape(transmitted.shape[:-1] + (-1, 32, 128))
+        m = np.arange(32, dtype=np.int64)[:, None]
+        words = (s3.astype(np.int64) << m).sum(axis=-2)
+        # fold into int32 range (bit 31 is the sign bit)
+        words = np.where(words >= 2 ** 31, words - 2 ** 32, words)
+        return np.ascontiguousarray(
+            words.reshape(words.shape[:-2] + (-1,)).astype(np.int32))
 
     def latest_epoch(self) -> Optional[int]:
         if not os.path.exists(self._meta_path()):
@@ -184,21 +211,39 @@ class CheckpointManager:
             try:
                 state = _restore_checked(host_template)
             except ValueError:
-                # v0.2 -> v0.3 engine-memory migration: the deferred-mask
-                # state was a keep MASK ('keep_c', 1.0 = keep); it is now a
-                # transmit COUNT ('sent_c', 0.0 = keep). Retry with the
-                # legacy key and convert (sent = 1 - keep) so old runs
-                # resume instead of silently restarting — pending deferred
-                # masks survive the conversion exactly.
-                legacy = self._legacy_keep_template(host_template)
-                if legacy is None:
+                # legacy engine-memory migrations, newest first. The
+                # deferred-mask state was a full-[T] f32 keep MASK in v0.2
+                # ('keep_c', 1.0 = keep) and a transmit COUNT in v0.3
+                # ('sent_c', 0.0 = keep); v0.4 packs it into int32 words
+                # ('sent_bits', kernels.pack_sent_bits). Retry with each
+                # legacy key and convert, so old runs resume instead of
+                # silently restarting — pending deferred masks survive the
+                # conversion exactly. (Multi-process restores skip the
+                # shape-changing migrations: the legacy leaf would need a
+                # sharding the template cannot supply.)
+                if jax.process_count() > 1:
                     raise
-                state = _restore_checked(legacy)
-                mem = dict(state.memory)
-                keep = mem.pop("keep_c")
-                mem["sent_c"] = jax.tree.map(lambda k: 1.0 - k, keep)
-                state = state.replace(memory=mem)
-                print(f"[checkpoint] migrated legacy keep_c mask at {path}")
+                state = None
+                for key, to_transmitted in (
+                        ("sent_c", lambda s: np.asarray(s) != 0.0),
+                        ("keep_c", lambda k: np.asarray(k) == 0.0)):
+                    legacy = self._legacy_sent_template(host_template, key)
+                    if legacy is None:
+                        raise
+                    try:
+                        state = _restore_checked(legacy)
+                    except ValueError:
+                        continue
+                    mem = dict(state.memory)
+                    bits = self._pack_transmitted_np(
+                        to_transmitted(mem.pop(key)))
+                    mem["sent_bits"] = bits
+                    state = state.replace(memory=mem)
+                    print(f"[checkpoint] migrated legacy {key} record at "
+                          f"{path}")
+                    break
+                if state is None:
+                    raise ValueError("no legacy memory layout matched")
         except ValueError as e:
             # on-disk structure from an older/incompatible state layout
             # (e.g. per-tensor vs flat buffers): train from scratch rather

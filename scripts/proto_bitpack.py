@@ -1,0 +1,171 @@
+"""Prototype: bit-packed transmit record for the masked compensate kernel.
+
+The engine's `sent_c` record is a full [T] f32 buffer today (one of the six
+HBM streams of the fused compensate pass, plus a fresh zero-init + scatter
+every step). Packing it 32x into int32 words needs an IN-KERNEL bit
+expansion Mosaic accepts; docs/RESULTS.md records two failed attempts
+(jnp.repeat failed to lower; a 4-way-where prototype hung the relay
+compile). This prototype tries the broadcast+reshape expansion:
+
+    bits [Wr, 128] int32, word (a, l) holds rows a*32..a*32+31 of lane l
+    expanded = broadcast_to(bits[:, None, :], (Wr, 32, 128)).reshape(R, 128)
+    keep[r, l] = ((expanded >> (r % 32)) & 1) == 0
+
+Run on the real chip: correctness vs the f32-mask reference, then a paired
+scan-loop timing at ResNet-50's T.
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_CHUNK_ROWS = 2048  # must be a multiple of 32
+
+
+def _kernel(g_ref, m_ref, v_ref, b_ref, om_ref, ov_ref, *, momentum,
+            nesterov, momentum_masking):
+    g = g_ref[:]
+    rows = g.shape[0]
+    b = b_ref[:]                                   # [rows//32, 128]
+    exp = jnp.broadcast_to(b[:, None, :], (rows // 32, 32, _LANE)).reshape(
+        rows, _LANE)
+    r = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANE), 0)
+    bit = (exp >> (r & 31)) & 1
+    keep = (bit == 0).astype(g.dtype)
+    m0 = m_ref[:].astype(g.dtype)
+    if momentum_masking:
+        m0 = m0 * keep
+    v0 = v_ref[:].astype(g.dtype) * keep
+    if nesterov:
+        m = (m0 + g) * momentum
+        ov_ref[:] = (v0 + m + g).astype(ov_ref.dtype)
+    else:
+        m = momentum * m0 + g
+        ov_ref[:] = (v0 + m).astype(ov_ref.dtype)
+    om_ref[:] = m.astype(om_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("momentum", "nesterov",
+                                             "momentum_masking"))
+def compensate_packed(grad, mmt, vec, bits, momentum, nesterov=False,
+                      momentum_masking=True):
+    n = grad.shape[0]
+    assert n % (32 * _LANE) == 0, n
+    rows = n // _LANE
+    g2, m2, v2 = (x.reshape(rows, _LANE) for x in (grad, mmt, vec))
+    b2 = bits.reshape(rows // 32, _LANE)
+    block_rows = min(_CHUNK_ROWS, rows)
+    grid = pl.cdiv(rows, block_rows)
+    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((block_rows // 32, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    om, ov = pl.pallas_call(
+        functools.partial(_kernel, momentum=momentum, nesterov=nesterov,
+                          momentum_masking=momentum_masking),
+        grid=(grid,),
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANE), mmt.dtype),
+                   jax.ShapeDtypeStruct((rows, _LANE), vec.dtype)),
+        in_specs=[spec, spec, spec, bspec],
+        out_specs=(spec, spec),
+        interpret=jax.default_backend() != "tpu",
+    )(g2, m2, v2, b2)
+    return om.reshape(-1), ov.reshape(-1)
+
+
+def pack_bits(idx, T):
+    """Scatter transmit indices into the packed word layout:
+    word w = (p // 4096) * 128 + (p % 128), bit (p // 128) % 32."""
+    w = (idx >> 12) * 128 + (idx & 127)
+    bit = (idx >> 7) & 31
+    return jnp.zeros((T // 32,), jnp.int32).at[w].add(
+        jnp.int32(1) << bit, mode="drop")
+
+
+def main():
+    print("backend:", jax.default_backend())
+    key = jax.random.PRNGKey(0)
+    from dgc_tpu.ops import kernels
+
+    T = 32 * 128 * 9  # small unaligned-ish case (multiple of 4096)
+    for T in (32 * 128 * 9, 23_556_096 // 4096 * 4096):
+        kg, km, kv, ki = jax.random.split(jax.random.fold_in(key, T), 4)
+        g = jax.random.normal(kg, (T,), jnp.float32)
+        m = jax.random.normal(km, (T,), jnp.float32)
+        v = jax.random.normal(kv, (T,), jnp.float32)
+        nsel = max(8, T // 1000)
+        idx = jax.random.choice(ki, T, (nsel,), replace=False)
+        sent = jnp.zeros((T,), jnp.float32).at[idx].add(1.0)
+        bits = pack_bits(idx, T)
+        for nesterov in (False, True):
+            for mm in (True, False):
+                om0, ov0 = kernels.fused_compensate_masked_reference(
+                    g, m, v, sent, 0.9, nesterov, mm)
+                om1, ov1 = compensate_packed(g, m, v, bits, 0.9, nesterov,
+                                             mm)
+                ok = (jnp.array_equal(om0, om1) and
+                      jnp.array_equal(ov0, ov1))
+                print(f"T={T} nesterov={nesterov} mm={mm}: "
+                      f"{'BITWISE OK' if bool(ok) else 'MISMATCH'}")
+                assert bool(ok)
+
+    # paired scan-loop timing at ResNet-50 scale: old (f32 sent stream)
+    # vs packed
+    T = 23_556_096 // 4096 * 4096
+    kg, km, kv, ki = jax.random.split(key, 4)
+    g = jax.random.normal(kg, (T,), jnp.float32)
+    m = jax.random.normal(km, (T,), jnp.float32)
+    v = jax.random.normal(kv, (T,), jnp.float32)
+    idx = jax.random.choice(ki, T, (25_533,), replace=False)
+    sent = jnp.zeros((T,), jnp.float32).at[idx].add(1.0)
+    bits = pack_bits(idx, T)
+
+    K = 50
+
+    @jax.jit
+    def loop_old(g, m, v, sent):
+        def body(c, _):
+            m, v = c
+            m, v = kernels.fused_compensate_masked(g, m, v, sent, 0.9,
+                                                   False, True)
+            return (m, v), ()
+        (m, v), _ = jax.lax.scan(body, (m, v), None, length=K)
+        return m[0] + v[0]
+
+    @jax.jit
+    def loop_new(g, m, v, bits):
+        def body(c, _):
+            m, v = c
+            m, v = compensate_packed(g, m, v, bits, 0.9, False, True)
+            return (m, v), ()
+        (m, v), _ = jax.lax.scan(body, (m, v), None, length=K)
+        return m[0] + v[0]
+
+    def run(f, *a):
+        x = f(*a)
+        return float(x)
+
+    run(loop_old, g, m, v, sent)
+    run(loop_new, g, m, v, bits)
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run(loop_old, g, m, v, sent)
+        t1 = time.perf_counter()
+        run(loop_new, g, m, v, bits)
+        t2 = time.perf_counter()
+        print(f"old {1e3 * (t1 - t0) / K:.3f} ms/iter  "
+              f"new {1e3 * (t2 - t1) / K:.3f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Paired single-process A/B of the transmit-record subgraph:
+v0.3 (f32 count vector: masked compensate + [T] zeros+scatter, [T] carry)
+vs v0.4 (bit-packed: bits compensate + [T/32] pack scatter, [T/32] carry).
+Interleaved rounds in ONE process so link drift cancels."""
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.ops import kernels
+
+T = 27_068_416
+K = 50
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    kg, km, kv, ki = jax.random.split(key, 4)
+    g = jax.random.normal(kg, (T,), jnp.float32)
+    m = jax.random.normal(km, (T,), jnp.float32)
+    v = jax.random.normal(kv, (T,), jnp.float32)
+    idx = jax.random.choice(ki, T, (25_533,), replace=False)
+
+    @jax.jit
+    def loop_old(g, m, v, idx):
+        sent0 = jnp.zeros((T,), jnp.float32).at[idx].add(1.0)
+
+        def body(c, _):
+            m, v, sent = c
+            m, v = kernels.fused_compensate_masked(g, m, v, sent, 0.9,
+                                                   False, True)
+            new = jnp.zeros((T,), jnp.float32).at[idx].add(1.0)
+            return (m, v, new), ()
+
+        (m, v, _), _ = jax.lax.scan(body, (m, v, sent0), None, length=K)
+        return m[0] + v[0]
+
+    @jax.jit
+    def loop_new(g, m, v, idx):
+        bits0 = kernels.pack_sent_bits(idx, T)
+
+        def body(c, _):
+            m, v, bits = c
+            m, v = kernels.fused_compensate_bits(g, m, v, bits, 0.9,
+                                                 False, True)
+            new = kernels.pack_sent_bits(idx, T)
+            return (m, v, new), ()
+
+        (m, v, _), _ = jax.lax.scan(body, (m, v, bits0), None, length=K)
+        return m[0] + v[0]
+
+    def run(f):
+        return float(f(g, m, v, idx))
+
+    run(loop_old)
+    run(loop_new)
+    diffs = []
+    for r in range(10):
+        t0 = time.perf_counter()
+        run(loop_old)
+        t1 = time.perf_counter()
+        run(loop_new)
+        t2 = time.perf_counter()
+        o, n = 1e3 * (t1 - t0) / K, 1e3 * (t2 - t1) / K
+        diffs.append(o - n)
+        print(f"old {o:.3f}  new {n:.3f}  diff {o - n:+.3f} ms/iter")
+    print(f"median old-minus-new: {statistics.median(diffs):+.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

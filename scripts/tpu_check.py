@@ -6,8 +6,10 @@ lowers to an exact sort there, so CI cannot catch a Mosaic compilation or
 recall regression. This script runs ON THE REAL CHIP and asserts:
 
 1. compiled-Pallas == interpret-mode (bitwise) for fused_compensate,
-   fused_compensate_masked, ladder_counts, and topk_rows at the engine's
-   ResNet-50 operating shapes;
+   fused_compensate_masked, fused_compensate_bits (the shipped bit-packed
+   transmit record, incl. the half-group layout and the bf16 state form),
+   ladder_counts, and topk_rows at the engine's ResNet-50 operating
+   shapes;
 2. approx-selection recall >= 0.95 at every ResNet-50 approx bucket
    (exact top-k reference computed on the same device).
 
@@ -84,6 +86,37 @@ def check_kernels():
     rm, rv = kernels.fused_compensate_masked_reference(
         gb, mb, vb, sb, 0.9, True, True)
     out["fused_compensate_masked_bf16"] = bool(
+        np.array_equal(np.asarray(cm, np.float32),
+                       np.asarray(rm, np.float32))
+        and np.array_equal(np.asarray(cv, np.float32),
+                           np.asarray(rv, np.float32)))
+
+    # bit-packed transmit record (the engine's shipped masking path):
+    # compiled expansion must match the jnp unpack reference bitwise, in
+    # both the aligned and the half-group (n % 4096 == 2048) layouts,
+    # and in the mixed-dtype bf16-state form
+    for label, nn in (("", n), ("_halfgroup", n + 2048)):
+        idxs = jnp.asarray(rng.choice(nn, 25_533, replace=False)
+                           .astype(np.int32))
+        bits = kernels.pack_sent_bits(idxs, nn)
+        gg = jnp.asarray(rng.randn(nn), jnp.float32)
+        mm = jnp.asarray(rng.randn(nn), jnp.float32)
+        vv = jnp.asarray(rng.randn(nn), jnp.float32)
+        cm, cv = kernels.fused_compensate_bits(gg, mm, vv, bits, 0.9,
+                                               True, True)
+        rm, rv = kernels.fused_compensate_bits_reference(
+            gg, mm, vv, bits, 0.9, True, True)
+        out[f"fused_compensate_bits{label}"] = bool(
+            np.array_equal(np.asarray(cm), np.asarray(rm))
+            and np.array_equal(np.asarray(cv), np.asarray(rv)))
+    bitsb = kernels.pack_sent_bits(
+        jnp.asarray(rng.choice(n, 25_533, replace=False).astype(np.int32)),
+        n)
+    cm, cv = kernels.fused_compensate_bits(g, mb[:n], vb[:n], bitsb, 0.9,
+                                           True, True)
+    rm, rv = kernels.fused_compensate_bits_reference(
+        g, mb[:n], vb[:n], bitsb, 0.9, True, True)
+    out["fused_compensate_bits_bf16"] = bool(
         np.array_equal(np.asarray(cm, np.float32),
                        np.asarray(rm, np.float32))
         and np.array_equal(np.asarray(cv, np.float32),

@@ -95,12 +95,12 @@ def test_topology_mismatch_raises_clearly(tmp_path):
         mgr.restore(_state(0.0), topology=other)
 
 
-def test_legacy_keep_mask_checkpoint_migrates(tmp_path):
-    """v0.2 checkpoints carry the deferred-mask state as a keep MASK
-    ('keep_c', 1.0 = keep); v0.3 stores a transmit COUNT ('sent_c',
-    0.0 = keep). Restoring an old checkpoint into the new template must
-    MIGRATE (sent = 1 - keep, pending masks preserved exactly), not
-    silently restart training."""
+def test_legacy_transmit_record_checkpoints_migrate(tmp_path):
+    """v0.2 checkpoints carry the deferred-mask state as a full-[T] keep
+    MASK ('keep_c', 1.0 = keep); v0.3 as a transmit COUNT ('sent_c',
+    0.0 = keep); v0.4 packs it into int32 words ('sent_bits'). Restoring
+    either legacy layout into the current template must MIGRATE (pending
+    masks preserved exactly as packed bits), not silently restart."""
 
     def flat_state(mem):
         return TrainState(step=jnp.zeros((), jnp.int32),
@@ -108,21 +108,31 @@ def test_legacy_keep_mask_checkpoint_migrates(tmp_path):
                           opt_state=(jnp.zeros(()),),
                           memory=mem, batch_stats={})
 
-    keep = jnp.asarray([1., 0., 1., 1., 0., 1., 1., 1.])
-    old = flat_state({"momentums_c": jnp.full((8,), 2.0),
-                      "velocities_c": jnp.full((8,), 3.0),
-                      "keep_c": keep})
-    mgr = CheckpointManager(str(tmp_path), keep=3)
-    mgr.save(0, old, {"m": 1.0})
+    # transmitted coordinates {1, 4} of T=8
+    keep = np.array([1., 0., 1., 1., 0., 1., 1., 1.], np.float32)
+    counts = np.array([0., 2., 0., 0., 1., 0., 0., 0.], np.float32)
+    expected_bits = CheckpointManager._pack_transmitted_np(keep == 0.0)
+    assert expected_bits.shape == (128,)          # ceil(8/4096)*128 words
+    # p < 128 lands in word p, bit 0 (row 0 of word group 0)
+    assert expected_bits[1] == 1 and expected_bits[4] == 1
+    assert expected_bits.sum() == 2
 
-    new_template = flat_state({"momentums_c": jnp.zeros((8,)),
-                               "velocities_c": jnp.zeros((8,)),
-                               "sent_c": jnp.zeros((8,))})
-    out = mgr.restore(new_template)
-    assert out is not None, "legacy checkpoint must migrate, not restart"
-    state, epoch, _ = out
-    assert "keep_c" not in state.memory
-    np.testing.assert_array_equal(np.asarray(state.memory["sent_c"]),
-                                  1.0 - np.asarray(keep))
-    np.testing.assert_array_equal(np.asarray(state.memory["momentums_c"]),
-                                  2.0)
+    for key, legacy_vec in (("keep_c", keep), ("sent_c", counts)):
+        old = flat_state({"momentums_c": jnp.full((8,), 2.0),
+                          "velocities_c": jnp.full((8,), 3.0),
+                          key: jnp.asarray(legacy_vec)})
+        mgr = CheckpointManager(str(tmp_path / key), keep=3)
+        mgr.save(0, old, {"m": 1.0})
+
+        new_template = flat_state({
+            "momentums_c": jnp.zeros((8,)),
+            "velocities_c": jnp.zeros((8,)),
+            "sent_bits": jnp.zeros((128,), jnp.int32)})
+        out = mgr.restore(new_template)
+        assert out is not None, f"{key} checkpoint must migrate"
+        state, epoch, _ = out
+        assert key not in state.memory
+        np.testing.assert_array_equal(np.asarray(state.memory["sent_bits"]),
+                                      expected_bits)
+        np.testing.assert_array_equal(
+            np.asarray(state.memory["momentums_c"]), 2.0)

@@ -340,7 +340,9 @@ def test_flat_matches_per_tensor_exchange_bf16_memory(mesh8):
     mem0 = engine.init_memory()
     assert mem0["momentums_c"].dtype == jnp.bfloat16
     assert mem0["velocities_d"].dtype == jnp.bfloat16
-    assert mem0["sent_c"].dtype == jnp.float32     # scatter stays word-wide
+    # the packed transmit record stays int32 words regardless of the
+    # narrow state dtype (word-wide scatter, bit-expansion on read)
+    assert mem0["sent_bits"].dtype == jnp.int32
     mem_p0 = dist_p.init_memory(params)
     assert all(v.dtype == jnp.bfloat16 for v in mem_p0["momentums"].values())
 
@@ -897,7 +899,7 @@ def test_flat_memory_state_dict_roundtrip():
     params, comp, dist = _make_dist(sample_ratio=1.0, ratio=0.05)
     layout, engine = dist.make_flat(params)
     mem = engine.init_memory()
-    mem = {k: v if k == "sent_c"
+    mem = {k: v if k == "sent_bits"
            else v + (1.0 if k.startswith("momentums") else 2.0)
            for k, v in mem.items()}
     sd = engine.memory_state_dict(mem)

@@ -111,6 +111,96 @@ def test_fused_compensate_masked_matches_reference(n, nesterov,
                                rtol=1e-6, atol=1e-6)
 
 
+def _random_indices(rng, n, frac=0.01):
+    k = max(1, int(n * frac))
+    return jnp.asarray(rng.choice(n, k, replace=False).astype(np.int32))
+
+
+@pytest.mark.parametrize("n", [4096, 3 * 4096, 65536 + 2048])
+def test_pack_sent_bits_roundtrip(n):
+    """pack -> unpack must reproduce the transmitted set exactly,
+    including the half-aligned tail case (n % 4096 == 2048: phantom rows
+    in the last word group never get bits)."""
+    rng = np.random.RandomState(n)
+    idx = _random_indices(rng, n, 0.03)
+    bits = kernels.pack_sent_bits(idx, n)
+    assert bits.dtype == jnp.int32
+    assert bits.shape == (kernels.num_sent_words(n),)
+    keep = np.asarray(kernels.keep_from_bits(bits, n))
+    expect = np.ones((n,), np.float32)
+    expect[np.asarray(idx)] = 0.0
+    np.testing.assert_array_equal(keep, expect)
+
+
+def test_pack_sent_bits_drops_sentinel():
+    """Padded payload slots all carry the sentinel index; repeated
+    single-bit adds there would carry into neighboring rows' bits, so
+    the sentinel must be dropped outright."""
+    n = 4096
+    sentinel = 130
+    idx = jnp.asarray([5, sentinel, sentinel, sentinel, 700], jnp.int32)
+    bits = kernels.pack_sent_bits(idx, n, sentinel=sentinel)
+    keep = np.asarray(kernels.keep_from_bits(bits, n))
+    assert keep[5] == 0.0 and keep[700] == 0.0
+    assert keep[sentinel] == 1.0              # dropped, not recorded
+    assert keep.sum() == n - 2
+
+
+@pytest.mark.parametrize("momentum_masking", [False, True])
+@pytest.mark.parametrize("nesterov", [False, True])
+@pytest.mark.parametrize("n", [4096, 2 * 4096 + 2048, 65536])
+def test_fused_compensate_bits_matches_masked(n, nesterov,
+                                              momentum_masking):
+    """The bit-packed kernel must equal its jnp reference AND the f32
+    count-vector kernel on the same transmitted set (the packed record
+    replaces the count vector bitwise)."""
+    rng = np.random.RandomState(n + 11)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n), jnp.float32)
+    v = jnp.asarray(rng.randn(n), jnp.float32)
+    idx = _random_indices(rng, n, 0.02)
+    sent = jnp.zeros((n,), jnp.float32).at[idx].add(1.0)
+    bits = kernels.pack_sent_bits(idx, n)
+    om, ov = kernels.fused_compensate_bits(g, m, v, bits, 0.9, nesterov,
+                                           momentum_masking)
+    rm, rv = kernels.fused_compensate_bits_reference(
+        g, m, v, bits, 0.9, nesterov, momentum_masking)
+    np.testing.assert_allclose(np.asarray(om), np.asarray(rm),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ov), np.asarray(rv),
+                               rtol=1e-6, atol=1e-6)
+    em, ev = kernels.fused_compensate_masked_reference(
+        g, m, v, sent, 0.9, nesterov, momentum_masking)
+    np.testing.assert_array_equal(np.asarray(rm), np.asarray(em))
+    np.testing.assert_array_equal(np.asarray(rv), np.asarray(ev))
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_fused_compensate_bits_bf16_state(nesterov):
+    """Bit-packed masking with the narrow bf16 error-feedback state:
+    matches its reference bitwise and the count-vector reference."""
+    n = 4096 + 2048
+    rng = np.random.RandomState(17)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(n), jnp.bfloat16)
+    idx = _random_indices(rng, n, 0.05)
+    sent = jnp.zeros((n,), jnp.float32).at[idx].add(1.0)
+    bits = kernels.pack_sent_bits(idx, n)
+    om, ov = kernels.fused_compensate_bits(g, m, v, bits, 0.9, nesterov,
+                                           True)
+    rm, rv = kernels.fused_compensate_bits_reference(
+        g, m, v, bits, 0.9, nesterov, True)
+    assert om.dtype == jnp.bfloat16 and ov.dtype == jnp.bfloat16
+    f32 = lambda x: np.asarray(x, np.float32)
+    np.testing.assert_allclose(f32(om), f32(rm), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(f32(ov), f32(rv), rtol=1e-2, atol=1e-2)
+    em, ev = kernels.fused_compensate_masked_reference(
+        g, m, v, sent, 0.9, nesterov, True)
+    np.testing.assert_array_equal(f32(rm), f32(em))
+    np.testing.assert_array_equal(f32(rv), f32(ev))
+
+
 @pytest.mark.parametrize("shape", [(1, 64), (3, 128), (5, 1000), (16, 4096)])
 def test_ladder_counts_matches_reference(shape):
     rng = np.random.RandomState(shape[1])
