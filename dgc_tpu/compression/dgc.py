@@ -92,6 +92,7 @@ class DGCCompressor(Compressor):
                  fp16_values: bool = False, int32_indices: bool = True,
                  warmup_epochs: int = -1, warmup_coeff=None, *,
                  int8_values: bool = False,
+                 int8_error_feedback: bool = True,
                  approx_recall: float = 0.90, verbose: bool = False):
         self.fp16_values = fp16_values
         #: int8-quantized wire values with one f32 scale per TENSOR
@@ -99,10 +100,19 @@ class DGCCompressor(Compressor):
         #: addresses the reference's own stated caveat — "no
         #: quantization/encoding of payloads" (README.md:130-138) — and
         #: cuts per-element wire bytes 8 -> 5 (f32+int32) or 6 -> 5
-        #: (fp16 wire). Quantization error (<= scale/254 per transmitted
-        #: value) is NOT error-fed-back (same property as the fp16 wire);
-        #: accuracy validated on the parity task (docs/RESULTS.md).
+        #: (fp16 wire).
         self.int8_values = int8_values
+        #: quantization ERROR FEEDBACK (default on): the transmitted value
+        #: is ``q*scale``, not the selected velocity ``v`` — with feedback
+        #: the residual ``v - q*scale`` stays in the velocity (instead of
+        #: zeroing the coordinate, reference memory.py:72-77) and is
+        #: retransmitted by later steps, the same guarantee the DGC memory
+        #: already gives unselected coordinates. Costs one payload-sized
+        #: subtract+scatter per step; removes the int8 wire's only
+        #: un-fed-back error source (the reference's fp16 wire precedent,
+        #: dgc/horovod/compression.py:69, keeps its loss unfed — we do
+        #: better). Off reproduces the round-3 no-feedback behavior.
+        self.int8_error_feedback = int8_error_feedback
         if int8_values and fp16_values:
             raise ValueError("int8_values and fp16_values are mutually "
                              "exclusive wire formats")
@@ -262,6 +272,18 @@ class DGCCompressor(Compressor):
                 # of magnitude across layers, a global scale would crush
                 # the small ones
                 q, scale = quantize_int8(values)
+                if self.int8_error_feedback:
+                    # what was actually transmitted is q*scale; put the
+                    # rounding residual back into the velocity the
+                    # update() above just zeroed — one subtract at
+                    # positions already in hand
+                    residual = jnp.where(
+                        valid,
+                        values - q.astype(values.dtype)
+                        * scale.astype(values.dtype),
+                        jnp.zeros((), values.dtype))
+                    mem_state = self.memory.feed_back(
+                        mem_state, name, indices, residual)
                 return (q, indices, scale), ctx, mem_state
             if self.fp16_values and jnp.issubdtype(values.dtype, jnp.floating):
                 values = values.astype(jnp.float16)

@@ -1153,15 +1153,15 @@ class FlatDGCEngine:
         the same graceful degradation as the per-tensor path's
         ``name in attributes`` guard."""
         if local_axis is not None and local_size > 1:
-            if op == "adasum":
-                raise NotImplementedError(
-                    "hierarchical two-tier exchange composes with average/"
-                    "sum only; Adasum's pairwise reduction has no node-"
-                    "aggregated form here")
             # dense-over-ICI tier: full-precision node aggregation (the
-            # fp16 wire option applies to the slow DCN link only)
+            # fp16 wire option applies to the slow DCN link only). Under
+            # "adasum" the NODE MEAN is the logical Adasum participant —
+            # the node-aggregated form of the reference's Adasum
+            # (optimizer.py:197-367) with each "sparsified node" acting as
+            # one worker (Horovod's own hierarchical Adasum does the same:
+            # in-node sum + normalize, Adasum across nodes).
             flat_grad = jax.lax.psum(flat_grad, local_axis)
-            if op == "average":
+            if op in ("average", "adasum"):
                 flat_grad = flat_grad / local_size
         T, P = self.T, self.layout.total
         m = self._mem
@@ -1223,6 +1223,7 @@ class FlatDGCEngine:
         values, indices = self.sparsify(comp, key)
 
         dt = flat_grad.dtype
+        int8_ef = False
         if self._row_map is not None:
             # int8 wire: symmetric per-TENSOR quantization (one f32 scale
             # per row, segment-max over the tight payload) — the
@@ -1235,6 +1236,27 @@ class FlatDGCEngine:
             safe = jnp.where(scale > 0, scale, 1.0)
             q = jnp.clip(jnp.round(values / safe[self._row_map]),
                          -127, 127).astype(jnp.int8)
+            int8_ef = (m is not None
+                       and getattr(self.c, "int8_error_feedback", False))
+            if int8_ef:
+                # quantization ERROR FEEDBACK: the wire carried q*scale,
+                # so the velocity keeps the rounding residual
+                # ``values - q*scale`` instead of being zeroed. vc already
+                # holds ``values`` at these coordinates (comp IS the
+                # velocity), so one scatter-subtract of the dequantized
+                # payload leaves exactly the residual there — and the
+                # transmit record stays EMPTY this step (no deferred
+                # zeroing; the residual must survive the next compensate).
+                # Momentum masking (memory.py:72-77) happens eagerly
+                # instead, bitwise the same as the deferred form since
+                # nothing reads mmt in between. Padded slots carry
+                # (sentinel, q=0): a zero subtract at the structural-zero
+                # slot, a no-op.
+                dequant = (q.astype(jnp.float32)
+                           * scale[self._row_map]).astype(vc.dtype)
+                vc = vc.at[indices].add(-dequant)
+                if m.momentum_masking:
+                    mc = mc.at[indices].set(jnp.zeros((), mc.dtype))
             g_q = jax.lax.all_gather(q, axis_name)          # [W, payload]
             g_scales = jax.lax.all_gather(scale, axis_name)  # [W, rows]
             g_values = g_q.astype(dt) * jnp.take(
@@ -1268,9 +1290,12 @@ class FlatDGCEngine:
             # THIS step's transmit record for the next compensate:
             # bit-packed, one word-wide scatter over a 32x smaller buffer
             # (padded slots carry the sentinel and are dropped — their
-            # repeated single-bit adds would carry across bits)
-            new_bits = kernels.pack_sent_bits(
-                indices, T, sentinel=self.layout.sentinel)
+            # repeated single-bit adds would carry across bits). Under
+            # int8 error feedback the record stays empty — masking was
+            # applied eagerly above and the velocity keeps the residual.
+            new_bits = (jnp.zeros_like(mem["sent_bits"]) if int8_ef
+                        else kernels.pack_sent_bits(
+                            indices, T, sentinel=self.layout.sentinel))
 
         # --- dense fallback block: one collective + correction ---
         if P > T:
@@ -1370,9 +1395,8 @@ class FlatDenseExchange:
                  local_size: int = 1):
         if op == "adasum":
             if local_axis is not None and local_size > 1:
-                raise NotImplementedError(
-                    "hierarchical two-tier exchange composes with average/"
-                    "sum only")
+                # node-aggregated Adasum: the node mean is the participant
+                flat_grad = jax.lax.psum(flat_grad, local_axis) / local_size
             # full precision: fp16 dot/norm accumulations would overflow
             from dgc_tpu.optim.adasum import adasum_allreduce
             return adasum_allreduce(flat_grad, axis_name, world_size), mem

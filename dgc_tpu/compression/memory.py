@@ -40,6 +40,11 @@ class Memory:
     def update(self, state: Dict, name: str, indices, valid) -> Dict:
         return state
 
+    def feed_back(self, state: Dict, name: str, indices, residual) -> Dict:
+        """Return wire-rounding residuals to the error-feedback state (the
+        int8 wire's quantization error); no state, nothing to feed."""
+        return state
+
     # Checkpoint protocol parity (reference memory.py:22-28): state *is* the
     # checkpointable object in the functional design.
     def state_dict(self, state: Dict):
@@ -144,6 +149,18 @@ class DGCSGDMemory(Memory):
         else:
             momentums = state["momentums"]
         return {"momentums": momentums, "velocities": velocities}
+
+    def feed_back(self, state: Dict, name: str, indices, residual) -> Dict:
+        """Scatter wire-rounding residuals back into the velocity at the
+        transmitted coordinates ``update`` just zeroed (int8 wire error
+        feedback — residual slots for padded indices must already be 0).
+        The coordinate then holds exactly the part of the velocity the
+        wire failed to deliver, and later steps retransmit it like any
+        other accumulated coordinate."""
+        vel = state["velocities"][name]
+        vel = vel.at[indices].add(residual.astype(vel.dtype))
+        return {"momentums": state["momentums"],
+                "velocities": {**state["velocities"], name: vel}}
 
     def state_dict(self, state: Dict):
         return state

@@ -90,19 +90,20 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
 
     The base optimizer steps on LOCAL gradients (reference
     optimizer.py:267-275), so its state is per-worker — the train step
-    stores it with a leading [world] axis like the DGC memory."""
+    stores it with a leading [world] axis like the DGC memory.
+
+    **Two-tier composition** (``local_axis_name`` set): the node-aggregated
+    Adasum — per-worker deltas are dense-MEANED over the near-free ICI
+    axis first, then each node acts as ONE Adasum participant across the
+    host/DCN axis (sparse payloads scatter-add summed, the dense tail
+    pairwise-Adasum-combined). This is Horovod's own hierarchical Adasum
+    recipe (in-node reduce + normalize, Adasum across nodes) applied to
+    the reference's "sparsified nodes" regime
+    (/root/reference/README.md:126-128): mathematically the reference's
+    Adasum (optimizer.py:197-367) with the node mean as each worker's
+    delta."""
 
     per_worker_opt_state = True
-
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        if self.local_axis_name is not None:
-            # without this, update_flat would run the exchange over the
-            # host axis only while the step builder shards data over both
-            # tiers — silent divergence instead of a clear error
-            raise NotImplementedError(
-                "Adasum does not compose with the two-tier hierarchical "
-                "exchange; use the default DistributedOptimizer or flat DP")
 
     def update(self, grads, opt_state, params, mem_state, key=None):
         """Per-tensor Adasum delta exchange (reference
@@ -144,6 +145,7 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
         updates, opt_state = self.optimizer.update(flat_grads, opt_state,
                                                    flat_params)
         reduced, mem_state = engine.exchange(
-            updates, mem_state, key, self.axis_name, self.world_size,
-            op="adasum")
+            updates, mem_state, key, self.axis_name, self.num_nodes,
+            op="adasum", local_axis=self.local_axis_name,
+            local_size=self.local_size)
         return reduced, opt_state, mem_state

@@ -25,7 +25,8 @@ __all__ = ["initialize_multihost", "is_coordinator", "local_batch_slice"]
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> bool:
+                         process_id: Optional[int] = None,
+                         **timeouts) -> bool:
     """Call ``jax.distributed.initialize`` when running multi-host.
 
     With no arguments, TPU pod environments are auto-detected (the TPU
@@ -34,6 +35,14 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
     — the same triple the launcher scripts derive from Slurm variables
     (reference sample_slurm.sh:36-52 builds the equivalent -H list).
+
+    ``timeouts`` forwards ``initialization_timeout`` /
+    ``heartbeat_timeout_seconds`` / ``shutdown_timeout_seconds`` to
+    ``jax.distributed.initialize``. The shutdown timeout matters on cold
+    machines: processes reach the coordination service's shutdown barrier
+    skewed by however much their compile times diverge, and the 300 s
+    default is shorter than a cold multi-minute XLA compile — the barrier
+    then kills the healthy process with DEADLINE_EXCEEDED.
 
     Returns True when distributed init ran, False for single-process runs.
     """
@@ -62,7 +71,8 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
-        process_id=process_id)
+        process_id=process_id,
+        **timeouts)
     return True
 
 

@@ -67,36 +67,43 @@ class CheckpointManager:
             self._ckptr.save(path, state)      # collective: global arrays
             self._ckptr.wait_until_finished()
             multihost_utils.sync_global_devices(f"ckpt_post_save_e{epoch}")
-            if not coord:
-                return path
         else:
             host_state = jax.tree.map(np.asarray, jax.device_get(state))
             if os.path.exists(path):
                 shutil.rmtree(path)
             self._ckptr.save(path, host_state)
             self._ckptr.wait_until_finished()
-        with open(os.path.join(path, "meters.json"), "w") as f:
-            payload = {k: float(v) for k, v in meters.items()}
-            payload["epoch"] = epoch
-            if topology:
-                # process/mesh topology the state was written under —
-                # restoring under a different one would otherwise fail deep
-                # in orbax/XLA with an opaque sharding error (or silently
-                # reinterpret per-worker error-feedback state)
-                payload["_topology"] = dict(topology)
-            json.dump(payload, f)
-        with open(self._meta_path(), "w") as f:
-            json.dump({"epoch": epoch}, f)
-        if best:
-            best_path = os.path.join(self.directory, "best")
-            if os.path.exists(best_path):
-                shutil.rmtree(best_path)
-            shutil.copytree(path, best_path)
-        # rotate: keep the last `keep` epoch dirs (reference keeps 3)
-        old = epoch - self.keep
-        old_path = self._epoch_dir(old)
-        if old >= 0 and os.path.exists(old_path):
-            shutil.rmtree(old_path)
+        if coord:
+            with open(os.path.join(path, "meters.json"), "w") as f:
+                payload = {k: float(v) for k, v in meters.items()}
+                payload["epoch"] = epoch
+                if topology:
+                    # process/mesh topology the state was written under —
+                    # restoring under a different one would otherwise fail
+                    # deep in orbax/XLA with an opaque sharding error (or
+                    # silently reinterpret per-worker error-feedback state)
+                    payload["_topology"] = dict(topology)
+                json.dump(payload, f)
+            with open(self._meta_path(), "w") as f:
+                json.dump({"epoch": epoch}, f)
+            if best:
+                best_path = os.path.join(self.directory, "best")
+                if os.path.exists(best_path):
+                    shutil.rmtree(best_path)
+                shutil.copytree(path, best_path)
+            # rotate: keep the last `keep` epoch dirs (reference keeps 3)
+            old = epoch - self.keep
+            old_path = self._epoch_dir(old)
+            if old >= 0 and os.path.exists(old_path):
+                shutil.rmtree(old_path)
+        if multi:
+            # a process must not leave save() (and possibly restore
+            # straight away) before the coordinator has written the
+            # latest/best pointers and finished rotating — without this
+            # fence a non-coordinator's immediate restore() can read a
+            # missing/stale latest.json and silently report "nothing to
+            # resume" (observed as a test flake under cold-compile skew)
+            multihost_utils.sync_global_devices(f"ckpt_meta_e{epoch}")
         return path
 
     # ------------------------------------------------------------------ #
@@ -222,6 +229,18 @@ class CheckpointManager:
                 # shape-changing migrations: the legacy leaf would need a
                 # sharding the template cannot supply.)
                 if jax.process_count() > 1:
+                    if self._legacy_sent_template(host_template,
+                                                  "sent_c") is not None:
+                        # don't leave only the generic "incompatible,
+                        # ignoring" line: a legacy checkpoint IS
+                        # recoverable, just not from here — the operator
+                        # should migrate it before the multi-process run
+                        # silently restarts from scratch
+                        print("[checkpoint] NOTE: this may be a legacy "
+                              "(v0.2/v0.3) memory layout, which cannot be "
+                              "migrated under multi-process restore; run a "
+                              "single-process restore+save once to migrate "
+                              "it, then resume multi-process")
                     raise
                 state = None
                 for key, to_transmitted in (

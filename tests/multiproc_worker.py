@@ -51,7 +51,13 @@ def main():
     os.environ["JAX_COORDINATOR_ADDRESS"] = coord
     os.environ["JAX_NUM_PROCESSES"] = str(num_procs)
     os.environ["JAX_PROCESS_ID"] = str(proc_id)
-    assert initialize_multihost() is True
+    # cold-cache runs compile the train steps from scratch (minutes on a
+    # loaded 1-core host) and the two processes' compile times diverge;
+    # the default 300 s shutdown barrier / 100 s heartbeat then kill the
+    # process that finished first while its peer is still compiling
+    assert initialize_multihost(initialization_timeout=600,
+                                heartbeat_timeout_seconds=600,
+                                shutdown_timeout_seconds=1200) is True
     assert jax.process_count() == num_procs
     assert is_coordinator() == (proc_id == 0)
 

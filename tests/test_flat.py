@@ -448,6 +448,81 @@ def test_flat_matches_per_tensor_exchange_int8_wire(mesh8):
                 np.asarray(named_out_p[n][0]).reshape(-1),
                 rtol=1e-5, atol=1e-6,
                 err_msg=f"exchanged grads step {step} {n}")
+        # memory equivalence: the error-feedback residual (int8 EF) must
+        # land identically on both paths
+        full_f = _mem_full(engine, mem_f, w=0)
+        for mkey in ("momentums", "velocities"):
+            named_m_f = layout.unflatten_named(full_f[mkey], keep_1d=True)
+            for n in layout.names:
+                np.testing.assert_allclose(
+                    np.asarray(named_m_f[n]),
+                    np.asarray(mem_p[mkey][n][0]).reshape(-1),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"{mkey} step {step} {n}")
+
+
+def test_int8_error_feedback_residual_semantics(mesh8):
+    """int8 wire + error feedback (the default): after one exchange, the
+    velocity at every transmitted coordinate holds exactly the
+    quantization residual ``v - q*scale`` (NOT zero), the momentum is
+    still masked, and with ``int8_error_feedback=False`` the round-3
+    zeroing behavior returns."""
+    params = _params()
+    named, _ = named_flatten(params)
+
+    def run(ef):
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0, int8_values=True,
+                             int8_error_feedback=ef)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                    world_size=W)
+        layout, engine = dist.make_flat(params)
+        rng = np.random.RandomState(2)
+        from dgc_tpu.utils.pytree import named_unflatten
+        grads_w = {n: jnp.asarray(rng.randn(W, *p.shape), jnp.float32)
+                   for n, p in named.items()}
+        flat_grads_w = jnp.stack([
+            layout.flatten(named_unflatten(
+                {n: grads_w[n][w] for n in named},
+                named_flatten(params)[1])) for w in range(W)])
+        fn = _flat_exchange_fn(dist, engine, mesh8)
+        mem = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+            engine.init_memory())
+        out, mem = fn(flat_grads_w, mem, jax.random.PRNGKey(0))
+        return layout, engine, flat_grads_w, mem
+
+    layout, engine, fg, mem = run(ef=True)
+    # recompute worker 0's selection to find its transmitted coordinates:
+    # first step => velocity == momentum-corrected grad == grad (momentum
+    # buffers start at zero, vec = 0 + (0*m + g))
+    vec0 = np.asarray(fg[0][:layout.t_compressed])
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec0),
+                                         jax.random.fold_in(
+                                             jax.random.PRNGKey(0), 0))
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    real = idx != layout.sentinel
+    full = _mem_full(engine, mem, w=0)
+    vel, mmt = full["velocities"], full["momentums"]
+    # per-tensor symmetric scales over the payload rows
+    rm = np.asarray(engine._row_map)
+    scales = np.zeros(rm.max() + 1, np.float32)
+    for rr in np.unique(rm):
+        scales[rr] = np.abs(vals[rm == rr]).max() / 127.0
+    safe = np.where(scales > 0, scales, 1.0)
+    q = np.clip(np.round(vals / safe[rm]), -127, 127)
+    resid = vals - q * scales[rm]
+    np.testing.assert_allclose(vel[idx[real]], resid[real],
+                               rtol=1e-5, atol=1e-7)
+    assert np.abs(resid[real]).max() > 0          # feedback is non-trivial
+    assert (mmt[idx[real]] == 0).all()            # momentum masked eagerly
+    # transmit record stays empty (no deferred zeroing may kill residuals)
+    assert not np.asarray(mem["sent_bits"]).any()
+
+    layout0, engine0, _, mem0 = run(ef=False)
+    full0 = _mem_full(engine0, mem0, w=0)
+    np.testing.assert_array_equal(full0["velocities"][idx[real]], 0.0)
 
 
 def test_int8_quantization_roundtrip_bound():
@@ -685,6 +760,60 @@ def test_split_bucket_stratified_selection(monkeypatch):
         ns = int(b.num_selects[s])
         expect.update(s * b.cols + np.argsort(-np.abs(seg))[:ns])
     assert got == expect
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),                                    # sampled + ladder adaptation
+    dict(sample_ratio=1.0),                    # exact (sample-everything)
+    dict(strided_sample=False),                # uniform resample
+    dict(resample=False),                      # two-sided batched adaptation
+])
+def test_payload_indices_unique(mesh8, kw):
+    """The engine's payload must never contain duplicate non-sentinel
+    indices: ``kernels.pack_sent_bits`` scatters single bits ADDITIVELY
+    (a repeated index would carry into a neighboring coordinate's bit and
+    silently corrupt its error-feedback mask), so uniqueness is a hard
+    precondition of the transmit record, not a style point. This pins it
+    at the payload level for every selection path — a future selection
+    change that emits duplicates fails here loudly."""
+    params, comp, dist = _make_dist(ratio=0.05, **kw)
+    layout, engine = dist.make_flat(params)
+    rng = np.random.RandomState(11)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    for n in layout.compressed_names:
+        o, s = layout.offsets[n], layout.sizes[n]
+        vec[o:o + s] = rng.randn(s).astype(np.float32)
+    for step in range(3):
+        _, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                          jax.random.PRNGKey(step))
+        idx = np.asarray(idx)
+        real = idx[idx != layout.sentinel]
+        assert len(np.unique(real)) == len(real), kw
+
+
+def test_payload_indices_unique_split_bucket(monkeypatch):
+    """Same duplicate-free guarantee through the segment-split (giant row)
+    path: segments partition the tensor, so cross-segment duplicates are
+    structurally impossible — assert it anyway at the payload level."""
+    import dgc_tpu.compression.flat as flat
+
+    monkeypatch.setattr(flat, "_SPLIT_COLS", 1024)
+    monkeypatch.setattr(flat, "_SPLIT_TARGET", 1024)
+    params = {"w": {"kernel": jnp.zeros((64, 128), jnp.float32)}}
+    comp = DGCCompressor(0.01, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=0.05)
+    comp.initialize([("w/kernel", (8192, (64, 128)))])
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+    layout, engine = dist.make_flat(params)
+    assert engine.buckets[0].rows > 1
+    rng = np.random.RandomState(5)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:8192] = rng.randn(8192).astype(np.float32)
+    _, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                      jax.random.PRNGKey(2))
+    idx = np.asarray(idx)
+    real = idx[idx != layout.sentinel]
+    assert len(np.unique(real)) == len(real)
 
 
 def test_3d_layout_free_selection_path(monkeypatch):

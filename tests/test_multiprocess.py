@@ -39,7 +39,10 @@ def test_two_process_train_save_resume(tmp_path):
     ]
     outs = []
     for p, lf in zip(procs, logs):
-        p.wait(timeout=570)
+        # generous: a cold compilation cache means several multi-minute
+        # XLA compiles per process on a loaded 1-core host (warm: ~30 s);
+        # the workers' own coordination timeouts are raised to match
+        p.wait(timeout=1500)
         lf.seek(0)
         outs.append(lf.read())
         lf.close()
