@@ -5,10 +5,14 @@ TPU-native extra with no reference counterpart — it addresses the
 reference's own stated caveat, "no quantization/encoding of payloads is
 performed" (/root/reference/README.md:130-138): per-element wire bytes
 drop 8 -> 5 (f32 values + int32 indices) on the sparse allgather.
-Quantization error (<= max|payload|/254 per transmitted value) is not
-error-fed-back, like the reference's fp16 wire option; accuracy
-validated on the parity task (docs/RESULTS.md). Mutually exclusive with
-`fp16.py`.
+Quantization error (<= max|payload|/254 per transmitted value) IS
+error-fed-back by default (`int8_error_feedback=True`): the rounding
+residual ``v - q*scale`` stays in the velocity and is retransmitted by
+later steps — the same guarantee the DGC memory gives unselected
+coordinates (pass ``--train.compression.int8_error_feedback False`` for
+the no-feedback form, which matches the reference's fp16-wire
+precedent). Accuracy validated on the parity task (docs/RESULTS.md).
+Mutually exclusive with `fp16.py`; composes with `packidx.py`.
 """
 
 from dgc_tpu.utils.config import configs

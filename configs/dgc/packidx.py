@@ -1,0 +1,16 @@
+"""Flag module: bit-packed sparse wire indices.
+
+TPU-native extra addressing the index half of the reference's "no
+quantization/encoding of payloads is performed" caveat
+(/root/reference/README.md:130-138): every payload slot belongs
+statically to one tensor row, so its index ships tensor-LOCAL in
+``ceil(log2 numel)`` bits instead of a 32-bit flat offset
+(dgc_tpu/compression/wirecodec.py). Composes with `int8.py` — together
+the wire drops from 8 to ~1 + bits/8 bytes per element (e.g. ~3.0 at
+ResNet-20 shapes). Decoded indices are bit-exact for every real payload
+slot; numerics are unchanged.
+"""
+
+from dgc_tpu.utils.config import configs
+
+configs.train.compression.packed_indices = True

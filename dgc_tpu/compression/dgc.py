@@ -93,6 +93,7 @@ class DGCCompressor(Compressor):
                  warmup_epochs: int = -1, warmup_coeff=None, *,
                  int8_values: bool = False,
                  int8_error_feedback: bool = True,
+                 packed_indices: bool = False,
                  approx_recall: float = 0.90, verbose: bool = False):
         self.fp16_values = fp16_values
         #: int8-quantized wire values with one f32 scale per TENSOR
@@ -113,6 +114,16 @@ class DGCCompressor(Compressor):
         #: dgc/horovod/compression.py:69, keeps its loss unfed — we do
         #: better). Off reproduces the round-3 no-feedback behavior.
         self.int8_error_feedback = int8_error_feedback
+        #: bit-packed index wire (flat engine only): each payload slot's
+        #: index ships tensor-LOCAL in ceil(log2 numel) bits instead of a
+        #: 32-bit flat offset (compression/wirecodec.py) — the index half
+        #: of the reference's "no quantization/encoding of payloads"
+        #: caveat (README.md:130-138); with int8 values the index was 4 of
+        #: every 5 wire bytes. Decoded indices are exactly the originals
+        #: for every real slot; padded slots land in-row with value 0.0
+        #: (a scatter-add no-op, SURVEY.md §2.5). The per-tensor oracle
+        #: path ignores the flag (wire format, not numerics).
+        self.packed_indices = packed_indices
         if int8_values and fp16_values:
             raise ValueError("int8_values and fp16_values are mutually "
                              "exclusive wire formats")

@@ -537,6 +537,14 @@ class FlatDGCEngine:
             self._row_map = jnp.asarray(np.concatenate(rm))
         else:
             self._row_map = None
+        # bit-packed index wire (compression/wirecodec.py): per-slot
+        # static tensor-local widths; the all_gather ships the uint32
+        # bitstream instead of [payload] int32 offsets
+        if getattr(compressor, "packed_indices", False) and self.payload_size:
+            from dgc_tpu.compression.wirecodec import IndexCodec
+            self._codec = IndexCodec(self.buckets)
+        else:
+            self._codec = None
 
     # -------------------------------------------------------------- #
     # memory (fused over the flat buffers)                           #
@@ -1266,7 +1274,15 @@ class FlatDGCEngine:
                            if self.c.fp16_values else values)
             g_values = jax.lax.all_gather(wire_values,
                                           axis_name)        # [W, payload]
-        g_indices = jax.lax.all_gather(indices, axis_name)
+        if self._codec is not None:
+            # packed index wire: gather the bitstream, decode per worker
+            # (static gathers + shifts; decoded == original for every
+            # real slot, padded slots land in-row with value 0.0)
+            g_words = jax.lax.all_gather(self._codec.encode(indices),
+                                         axis_name)
+            g_indices = self._codec.decode(g_words, self.index_dtype)
+        else:
+            g_indices = jax.lax.all_gather(indices, axis_name)
         # Averaging divides the [W, payload] WIRE values BEFORE the
         # scatter (algebraically identical to the reference's
         # scatter-then-divide, compression.py:192-193; differs by

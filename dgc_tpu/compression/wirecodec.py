@@ -1,0 +1,117 @@
+"""Bit-packed index wire encoding for the sparse payload.
+
+The reference ships its sparse payload as (fp32 value, int32 index) pairs
+and lists "no quantization/encoding of payloads is performed" among its
+caveats (/root/reference/README.md:130-138). The int8 value wire
+(``DGCCompressor(int8_values=True)``) answers the value half; with it the
+int32 index is 4 of every 5 wire bytes. This codec answers the index half.
+
+Every payload slot belongs STATICALLY to one tensor row (payload order is
+bucket-by-bucket, row-by-row, ``num_selects`` entries each — the same
+static map the int8 scale wire uses), so a slot's index can ship
+**tensor-local** in ``ceil(log2 numel)`` bits instead of a 32-bit flat
+offset. The per-slot bit widths and bit offsets are compile-time
+constants; packing is two word-wide scatter-adds over a ``uint32`` stream
+(bit ranges are disjoint across slots, so add == or, no carries), and
+unpacking is two static gathers + shifts per slot. Both ends are O(payload)
+elementwise work — noise next to the selection pipeline — while the wire
+drops to ``bits/8`` bytes per index (e.g. 16 bits for a 36k-element
+ResNet-20 conv, 22 bits for a 4M-element VGG fc segment, vs 32 on the
+int32 wire).
+
+Padded payload slots (fewer threshold passers than ``num_selects``) carry
+the global scatter sentinel, which is NOT in-row; they encode as an
+arbitrary clipped in-row position. That is safe by the same contract that
+makes the sentinel work: a padded slot's VALUE is exactly 0.0, and the
+decompress scatter-add tolerates zero contributions at any coordinate
+(SURVEY.md §2.5). The local transmit record (``pack_sent_bits``) is built
+from the pre-encoding indices and never sees the wire format.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["IndexCodec"]
+
+
+class IndexCodec:
+    """Static per-slot variable-width bit packing of payload indices.
+
+    Built from the engine's bucket list: per payload slot ``s`` the owning
+    row's flat offset ``off_s``, element count ``numel_s``, and bit width
+    ``w_s = max(1, ceil(log2 numel_s))``. ``encode`` maps [payload] global
+    indices -> [nwords] uint32; ``decode`` maps [..., nwords] -> [...,
+    payload] global indices (vectorized over leading axes, e.g. the
+    gathered [W, nwords] wire).
+    """
+
+    def __init__(self, buckets):
+        offs, numels = [], []
+        for b in buckets:
+            for r in range(b.rows):
+                ns = int(b.num_selects[r])
+                offs.append(np.full(ns, int(b.row_offsets[r]), np.int64))
+                numels.append(np.full(ns, int(b.numels[r]), np.int64))
+        if offs:
+            self.slot_off = np.concatenate(offs)
+            self.slot_numel = np.concatenate(numels)
+        else:
+            self.slot_off = np.zeros(0, np.int64)
+            self.slot_numel = np.ones(0, np.int64)
+        self.payload = int(self.slot_off.shape[0])
+        # locals lie in [0, numel): ceil(log2 numel) bits, minimum 1
+        widths = np.maximum(
+            1, np.ceil(np.log2(np.maximum(self.slot_numel, 2))).astype(
+                np.int64))
+        self.widths = widths.astype(np.int32)
+        bit_off = np.zeros(self.payload, np.int64)
+        if self.payload:
+            bit_off[1:] = np.cumsum(widths)[:-1]
+        self.total_bits = int(widths.sum())
+        self.nwords = -(-self.total_bits // 32) if self.payload else 0
+        self._w0 = (bit_off >> 5).astype(np.int32)
+        self._shift = (bit_off & 31).astype(np.uint32)
+        self._mask = ((np.uint64(1) << widths.astype(np.uint64)) - 1).astype(
+            np.uint32)
+
+    @property
+    def bits_per_index(self) -> float:
+        return self.total_bits / self.payload if self.payload else 0.0
+
+    def encode(self, indices: jax.Array) -> jax.Array:
+        """[payload] global flat indices -> [nwords] uint32 bitstream."""
+        if not self.payload:
+            return jnp.zeros((0,), jnp.uint32)
+        off = jnp.asarray(self.slot_off, indices.dtype)
+        hi_lim = jnp.asarray(self.slot_numel - 1, indices.dtype)
+        local = jnp.clip(indices - off, 0, hi_lim).astype(jnp.uint32)
+        shift = jnp.asarray(self._shift)
+        w0 = jnp.asarray(self._w0)
+        lo = local << shift
+        # the spill into the next word; shift==0 spills nothing (and
+        # uint32 >> 32 is undefined in XLA, so guard the shift amount)
+        spill = jnp.where(shift > 0, jnp.uint32(32) - shift, jnp.uint32(31))
+        hi = jnp.where(shift > 0, local >> spill, jnp.uint32(0))
+        words = jnp.zeros((self.nwords + 1,), jnp.uint32)
+        words = words.at[w0].add(lo).at[w0 + 1].add(hi)
+        return words[:self.nwords]
+
+    def decode(self, words: jax.Array,
+               out_dtype=jnp.int32) -> jax.Array:
+        """[..., nwords] uint32 -> [..., payload] global flat indices."""
+        if not self.payload:
+            return jnp.zeros(words.shape[:-1] + (0,), out_dtype)
+        pad = jnp.zeros(words.shape[:-1] + (1,), jnp.uint32)
+        wpad = jnp.concatenate([words, pad], axis=-1)
+        w0 = jnp.asarray(self._w0)
+        shift = jnp.asarray(self._shift)
+        lo = jnp.take(wpad, w0, axis=-1) >> shift
+        spill = jnp.where(shift > 0, jnp.uint32(32) - shift, jnp.uint32(31))
+        hi_w = jnp.take(wpad, w0 + 1, axis=-1)
+        hi = jnp.where(shift > 0, hi_w << spill, jnp.uint32(0))
+        local = (lo | hi) & jnp.asarray(self._mask)
+        return (jnp.asarray(self.slot_off, out_dtype)
+                + local.astype(out_dtype))

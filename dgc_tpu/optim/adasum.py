@@ -66,17 +66,31 @@ def adasum_allreduce(x: jax.Array, axis_name: str,
 
     Power-of-two worlds run recursive doubling over ``ppermute``: log2(W)
     rounds, O(P) memory per device, the same binary combine tree as
-    :func:`adasum_reduce` (``adasum_pair`` is symmetric, so partner order is
-    immaterial and every device converges to the identical result). Other
-    world sizes fall back to a gathered reduce (O(W*P) memory)."""
+    :func:`adasum_reduce`. ``adasum_pair`` is symmetric mathematically but
+    NOT bitwise under compilation (XLA fuses ``fa*a + fb*b`` into an FMA
+    whose rounding depends on operand order), so each pair's two members
+    must evaluate the combine with the IDENTICAL operand order: the
+    lower-indexed member's value always goes first. That determinism is
+    what makes every device converge to the bitwise-identical result — the
+    replication invariant the reference gets from its single collective
+    (/root/reference/dgc/horovod/optimizer.py:283-310). Other world sizes
+    fall back to a gathered reduce (O(W*P) memory), which is replicated by
+    construction (every device reduces the same [W, P] stack in the same
+    order)."""
     if world_size == 1:
         return x
     if world_size & (world_size - 1) == 0:
+        idx = jax.lax.axis_index(axis_name)
         d = 1
         while d < world_size:
             perm = [(i, i ^ d) for i in range(world_size)]
             other = jax.lax.ppermute(x, axis_name, perm)
-            x = adasum_pair(x, other)
+            # bit d of idx decides which pair member we are; order the
+            # operands so both members compute adasum_pair(lo, hi)
+            is_lo = (idx & d) == 0
+            lo = jnp.where(is_lo, x, other)
+            hi = jnp.where(is_lo, other, x)
+            x = adasum_pair(lo, hi)
             d *= 2
         return x
     return adasum_reduce(jax.lax.all_gather(x, axis_name))
@@ -116,8 +130,20 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
         (:283-310's ``op=Adasum`` allreduce) and take the
         non-accumulating momentum correction like any fallback tensor
         (compression.py:198). Parity path, not a performance one — the
-        flat-engine :meth:`update_flat` is the fast route."""
+        flat-engine :meth:`update_flat` is the fast route.
+
+        Two-tier (``local_axis_name`` set): the node-aggregated Adasum,
+        mirroring :meth:`update_flat`/the flat engine — per-worker deltas
+        are dense-MEANED over the local (ICI) axis first, then each node is
+        ONE Adasum participant across ``axis_name`` (``num_nodes``
+        participants, not ``world_size``)."""
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        if self.local_axis_name is not None:
+            # the node-mean delta is the Adasum participant (same recipe
+            # as FlatDGCEngine.exchange's op="adasum" two-tier branch)
+            updates = jax.tree.map(
+                lambda u: jax.lax.psum(u, self.local_axis_name)
+                / self.local_size, updates)
         named, treedef = named_flatten(updates)
         comp = self.compressor
         out = {}
@@ -127,12 +153,12 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
                                                     k)
             if getattr(ctx, "compressed", False):
                 gathered = comp.communicate(payload, ctx, self.axis_name,
-                                            self.world_size)
+                                            self.num_nodes)
                 out[name], mem_state = comp.decompress(
-                    gathered, ctx, mem_state, self.world_size, op="adasum")
+                    gathered, ctx, mem_state, self.num_nodes, op="adasum")
             else:
                 red = adasum_allreduce(delta, self.axis_name,
-                                       self.world_size)
+                                       self.num_nodes)
                 corrected, mem_state = comp.memory.compensate(
                     mem_state, name, red.reshape(-1), accumulate=False)
                 out[name] = corrected.reshape(delta.shape)

@@ -95,10 +95,14 @@ def build_arm(arm, variables, lr_sched, world, ratio, warmup_epochs, args):
         # (configs/dgc/bf16mem.py) to measure the narrow-state accuracy cost
         recall = None if arm == "dgc_exact" else args.approx_recall
         mem_dtype = "bfloat16" if arm == "dgc_bf16mem" else None
+        # "dgc_int8" is the SHIPPED int8 wire (error feedback on, the
+        # round-4 default); "dgc_int8nofb" is the no-feedback control
+        # (the round-3 behavior, int8_error_feedback=False)
         comp = DGCCompressor(
             ratio, memory=DGCSGDMemory(momentum=0.9, dtype=mem_dtype),
             warmup_epochs=warmup_epochs,
-            int8_values=(arm == "dgc_int8"),
+            int8_values=arm.startswith("dgc_int8"),
+            int8_error_feedback=(arm != "dgc_int8nofb"),
             approx_recall=recall)
         from dgc_tpu.utils.pytree import named_flatten
         named, _ = named_flatten(variables["params"])

@@ -25,7 +25,7 @@ W = 8
 
 @pytest.mark.parametrize("flag", ["wm0", "wm5", "wm5o", "fp16", "int32",
                                   "nm", "mm", "twotier", "bf16mem",
-                                  "int8"])
+                                  "int8", "packidx"])
 def test_dgc_flag_combo_runs_a_step(mesh8, flag, monkeypatch):
     # fresh global config tree per combo (the CLI process does this by
     # construction; tests must not leak state between combos)
@@ -73,6 +73,10 @@ def test_dgc_flag_combo_runs_a_step(mesh8, flag, monkeypatch):
         assert comp.warmup_epochs == 0 and comp.compress_ratio == 0.001
     if flag in ("wm5", "wm5o"):
         assert comp.compress_ratio > 0.001  # warm-up active at epoch 0
+    if flag == "packidx":
+        assert comp.packed_indices
+        assert setup.engine._codec is not None
+        assert setup.engine._codec.bits_per_index < 32
     if flag == "twotier":
         # harness-level flag (train.py builds the (hosts, local) mesh and
         # the hierarchical DistributedOptimizer from it; the exchange

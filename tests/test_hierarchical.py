@@ -411,20 +411,20 @@ def test_two_tier_adasum_matches_flat_oracle(mesh2x4):
                     rtol=1e-6, atol=1e-7,
                     err_msg=f"memory {k} node {h} step {step}")
     # the dense tail actually took the Adasum combine, not an average:
-    # feed opposed node deltas on the dense block; Adasum of a and -a/2
-    # (aligned, opposite sign) differs from their mean
-    from dgc_tpu.optim.adasum import adasum_pair
+    # feed ORTHOGONAL node deltas on the dense block — Adasum of
+    # orthogonal vectors is their SUM (fa = fb = 1), distinct from the
+    # mean. (Collinear probes cannot distinguish the two: for b = c*a the
+    # Adasum operator gives (1+c)/2 * a, identically the arithmetic mean.)
     db = layout.offsets[layout.dense_names[0]]
     probe = np.zeros((W, layout.total), np.float32)
-    probe[:L, db] = 1.0
-    probe[L:, db] = -0.5
+    probe[:L, db] = 1.0          # node 0's delta: e_db
+    probe[L:, db + 1] = 1.0      # node 1's delta: e_{db+1}, orthogonal
     out_p, _ = two_tier(jnp.asarray(probe),
                         with_leading_axis(engine.init_memory(), W),
                         jax.random.PRNGKey(9))
-    expect = float(adasum_pair(jnp.asarray([1.0]),
-                               jnp.asarray([-0.5]))[0])
-    assert np.asarray(out_p)[0, db] == pytest.approx(expect, rel=1e-6)
-    assert expect != pytest.approx(0.25)       # distinct from the mean
+    out_p = np.asarray(out_p)
+    assert out_p[0, db] == pytest.approx(1.0, rel=1e-6)       # sum, not 0.5
+    assert out_p[0, db + 1] == pytest.approx(1.0, rel=1e-6)
 
 
 def test_two_tier_adasum_distributed_optimizer_constructs():
@@ -439,3 +439,88 @@ def test_two_tier_adasum_distributed_optimizer_constructs():
                                      world_size=8, local_axis_name="local",
                                      local_size=4)
     assert opt.num_nodes == 2 and opt.per_worker_opt_state
+
+
+def test_two_tier_adasum_per_tensor_update_matches_flat(mesh2x4):
+    """The PER-TENSOR AdasumDistributedOptimizer.update() under a two-tier
+    config (the advisor-flagged branch): per-worker deltas are node-meaned
+    over the local axis, then ``num_nodes`` (not world_size) participants
+    exchange over the host axis — numerically equal to the flat
+    2-participant per-tensor update fed the node-mean gradients (sgd(0.1)
+    is linear, so mean-of-deltas == delta-of-mean), and replicated across
+    every worker."""
+    from dgc_tpu.optim.adasum import AdasumDistributedOptimizer
+
+    params = _params()
+    named, _ = named_flatten(params)
+
+    def make(two_tier):
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        from dgc_tpu import sgd
+        if two_tier:
+            return AdasumDistributedOptimizer(
+                sgd(0.1), comp, axis_name="hosts", world_size=W,
+                local_axis_name="local", local_size=L)
+        return AdasumDistributedOptimizer(sgd(0.1), comp,
+                                          axis_name="data", world_size=H)
+
+    dist_t = make(True)
+    assert dist_t.num_nodes == H
+    dist_f = make(False)
+    opt_state = dist_t.init(params)
+
+    rng = np.random.RandomState(17)
+    g_w = {n: jnp.asarray(
+        np.round(rng.randn(W, *p.shape) * 4096) / 4096, jnp.float32)
+        for n, p in named.items()}
+    g_nodes = {n: g_w[n].reshape(H, L, *g_w[n].shape[1:]).sum(1) / L
+               for n in named}
+    from dgc_tpu.utils.pytree import named_unflatten
+
+    def tt_worker(gw, mem, key):
+        g = named_unflatten(
+            {n: gw[n][0] for n in named}, named_flatten(params)[1])
+        mem = jax.tree.map(lambda x: x[0], mem)
+        key = jax.random.fold_in(key, jax.lax.axis_index("hosts"))
+        upd, _, mem = dist_t.update(g, opt_state, params, mem, key)
+        upd_named, _ = named_flatten(upd)
+        return ({n: upd_named[n][None] for n in named},
+                jax.tree.map(lambda x: x[None], mem))
+
+    axes = ("hosts", "local")
+    tt = jax.jit(jax.shard_map(
+        tt_worker, mesh=mesh2x4,
+        in_specs=({n: P(axes) for n in named}, P(axes), P()),
+        out_specs=({n: P(axes) for n in named}, P(axes)),
+        check_vma=False))
+
+    def flat_worker(gw, mem, key):
+        g = named_unflatten(
+            {n: gw[n][0] for n in named}, named_flatten(params)[1])
+        mem = jax.tree.map(lambda x: x[0], mem)
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        upd, _, mem = dist_f.update(g, opt_state, params, mem, key)
+        upd_named, _ = named_flatten(upd)
+        return ({n: upd_named[n][None] for n in named},
+                jax.tree.map(lambda x: x[None], mem))
+
+    mesh2 = make_mesh(H)
+    fl = jax.jit(jax.shard_map(
+        flat_worker, mesh=mesh2,
+        in_specs=({n: P("data") for n in named}, P("data"), P()),
+        out_specs=({n: P("data") for n in named}, P("data")),
+        check_vma=False))
+
+    mem_t = with_leading_axis(dist_t.init_memory(params), W)
+    mem_f = with_leading_axis(dist_f.init_memory(params), H)
+    key = jax.random.PRNGKey(0)
+    out_t, mem_t = tt(g_w, mem_t, key)
+    out_f, mem_f = fl(g_nodes, mem_f, key)
+    for n in named:
+        ot = np.asarray(out_t[n])
+        for w in range(1, W):
+            np.testing.assert_array_equal(ot[0], ot[w], err_msg=n)
+        np.testing.assert_allclose(ot[0], np.asarray(out_f[n][0]),
+                                   rtol=1e-5, atol=1e-7, err_msg=n)
