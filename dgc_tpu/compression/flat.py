@@ -872,25 +872,73 @@ class FlatDGCEngine:
         """Whether a bucket takes the 3-D lane-stratified selection path:
         approx allowed, genuinely sampled+strided (every row), and wide
         enough that the 2-D view's physical relayout is worth avoiding."""
+        return (self._sampled_strided_ok(b)
+                and b.cols % 128 == 0 and b.cols >= self.SEL3D_MIN_COLS)
+
+    def _sampled_strided_ok(self, b: "_Bucket") -> bool:
+        """Shared preconditions of both layout-free selection paths:
+        approx allowed, genuinely sampled+strided on every row, resample
+        adaptation (the ladder-from-topk derivation)."""
         return (self.c.approx_recall is not None and not b.exact
                 and self.c.strided_sample
-                and self.c.resample  # its adaptation is the resample ladder
-                and b.cols % 128 == 0 and b.cols >= self.SEL3D_MIN_COLS
+                and self.c.resample
                 and bool((b.strides > 1).all())
                 and bool((b.num_samples >= 128).all()))
 
-    def _sample_rows_3d(self, b: "_Bucket", imp3: jax.Array,
+    def _use_seg_kernel(self, b: "_Bucket") -> bool:
+        """Whether a bucket selects through the segment-top-2 candidates
+        kernel (kernels.seg_top2_candidates): the same sampled+strided
+        preconditions as :meth:`_use_3d`, plus the kernel's geometric
+        alignment and enough (lane, segment) cells that per-cell top-2
+        captures the top set (cells >= 3*k keeps the cell occupancy
+        ~Poisson(<=1/3), losing ~1%). Unlike the approx 3-D path the
+        kernel reads the flat buffer in place and emits signed values,
+        so it wins WITHOUT the SEL3D_MIN_COLS width gate — the round-3
+        negative result for 3-D-below-3M-cols was the PartialReduce
+        form's relayout-vs-remap trade, which the kernel does not pay."""
+        nb = b.cols // 128
+        cells = (nb // kernels._SEG_BLOCKS) * 128
+        m = self._mem
+        sdt = np.dtype(m.dtype) if (m is not None and m.dtype) else (
+            np.dtype(self.layout.dtype))
+        return (self._sampled_strided_ok(b)
+                and sdt.itemsize == 4   # bf16 state: (2,128) out blocks
+                                        # under-fill the 16-sublane tile
+                and cells >= 3 * b.max_sel
+                and kernels.seg_top2_eligible(
+                    self.T // 128, b.base, b.cols, b.rows))
+
+    def _sample_rows_3d(self, b: "_Bucket", v2d: jax.Array,
                         k: jax.Array) -> jax.Array:
         """Lane-block strided samples from the layout-free [R, nb, 128]
-        importance view — the SAME positions and values as
-        :meth:`_sample_rows` on the 2-D view (block j = lanes
-        [128j, 128j+128)), but sliced from a view whose reshape from the
-        flat buffer is a bitcast, not a relayout. Only the strided
-        n >= 128 branch exists here (the :meth:`_use_3d` gate)."""
+        RAW view — the SAME positions and values as :meth:`_sample_rows`
+        on the 2-D view (block j = lanes [128j, 128j+128)), but sliced
+        from a view whose reshape from the flat buffer is a bitcast, not
+        a relayout. Only the strided n >= 128 branch exists here (the
+        :meth:`_use_3d` gate).
+
+        Samples are drawn from the raw values and |.| is applied to the
+        small extracted blocks (|slice(x)| == slice(|x|), so the result
+        is identical) — deliberately, so the full-size importance
+        ``|v3|`` has exactly ONE consumer (the selection's PartialReduce)
+        and XLA fuses the abs into it instead of materializing a
+        tensor-sized importance array (measured ~3 ms/step of abs/copy
+        passes at VGG's fc buckets, device profile r5).
+
+        Extraction is ONE whole-row gather of the sampled 128-lane blocks
+        from the FULL-buffer [T/128, 128] bitcast view (no slice of the
+        bucket is ever taken; the block ids are static per row up to the
+        random phase). The earlier form — a [Rg, nb, sb, L] reshape of a
+        row slice + dynamic_slice at the phase — materialized nearly the
+        whole bucket span per stride group (~4 ms/step of slice copies at
+        VGG's fc buckets, device profile r5); the row gather touches only
+        the sampled 512 B blocks."""
         L = 128
+        nb_row = b.cols // L
+        base_blk = b.base // L
         widths = [-(-n // L) * L for (_, _, _, n) in b.stride_groups]
         width = max(widths)
-        neg1 = jnp.full((), -1.0, imp3.dtype)
+        neg1 = jnp.full((), -1.0, v2d.dtype)
         parts = []
         for gi, (r0, r1, stride, n) in enumerate(b.stride_groups):
             kg = jax.random.fold_in(k, gi)
@@ -899,10 +947,16 @@ class FlatDGCEngine:
             nb_s = -(-n // L)
             sb = max(1, (n * stride) // (nb_s * L))
             phase = jnp.floor(u * sb).astype(jnp.int32)
-            v4 = imp3[r0:r1, :nb_s * sb].reshape(Rg, nb_s, sb, L)
-            smp = jax.lax.dynamic_slice(
-                v4, (jnp.int32(0), jnp.int32(0), phase, jnp.int32(0)),
-                (Rg, nb_s, 1, L)).reshape(Rg, nb_s * L)
+            # block j of row r = lane-block j*sb + phase of the [R, nb,
+            # 128] view = row base_blk + (r0+r)*nb_row + j*sb + phase
+            rows = (base_blk
+                    + (r0 + jnp.arange(Rg, dtype=jnp.int32))[:, None]
+                    * nb_row
+                    + jnp.arange(nb_s, dtype=jnp.int32)[None, :] * sb
+                    + phase)                               # [Rg, nb_s]
+            smp = jnp.abs(jnp.take(v2d, rows.reshape(-1), axis=0,
+                                   indices_are_sorted=True)
+                          ).reshape(Rg, nb_s * L)
             if smp.shape[1] < width:
                 smp = jnp.concatenate(
                     [smp, jnp.full((Rg, width - smp.shape[1]), neg1)],
@@ -910,8 +964,8 @@ class FlatDGCEngine:
             parts.append(smp)
         return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
-    def _sparsify_bucket_3d(self, vec_c: jax.Array, b: "_Bucket",
-                            k: jax.Array):
+    def _sparsify_bucket_3d(self, vec_c: jax.Array, v2d: jax.Array,
+                            b: "_Bucket", k: jax.Array):
         """Layout-free selection over one wide bucket.
 
         The [R, cols] 2-D view is a PHYSICAL relayout of the flat buffer
@@ -936,10 +990,8 @@ class FlatDGCEngine:
         row_off = jnp.asarray(b.row_offsets,
                               dtype=self.index_dtype)[:, None]
         numels = jnp.asarray(b.numels)[:, None]
-        v3 = vec_c[b.base:b.base + R * cols].reshape(R, nb, 128)
-        imp3 = jnp.abs(v3)
 
-        samples = self._sample_rows_3d(b, imp3, k)
+        samples = self._sample_rows_3d(b, v2d, k)
         r = self.c.approx_recall
         if b.max_k > 128 or b.max_k * samples.shape[1] > 2_000_000:
             sorted_s = jax.lax.approx_max_k(samples, b.max_k,
@@ -950,15 +1002,51 @@ class FlatDGCEngine:
             sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
             axis=1)[:, 0]
 
-        kp = min(nb, -(-self.SEL3D_MARGIN * b.max_sel // 128))
-        cv, ci = jax.lax.approx_max_k(imp3, kp, reduction_dimension=1,
-                                      recall_target=float(r))
-        cand = cv.reshape(R, kp * 128)                 # [R, kp*128]
-        top_scores, c2 = self._select_topk(cand, b.max_sel)
-        lane = c2 % 128
-        blk = jnp.take_along_axis(ci.reshape(R, kp * 128), c2, axis=1)
-        cols_sel = blk.astype(self.index_dtype) * 128 + lane.astype(
-            self.index_dtype)
+        if self._use_seg_kernel(b):
+            # candidates kernel: per-(lane, 256-block segment) top-2 by
+            # |.|, streamed straight out of the flat buffer — no bucket
+            # slice, no tensor-sized importance array, and the SIGNED
+            # values + columns come out of the stream, so no payload-
+            # scale random gather afterwards (the r5 device profile
+            # attributed ~6 ms/step at VGG to that chain)
+            fn = (kernels.seg_top2_candidates if kernels.use_pallas()
+                  else kernels.seg_top2_reference)
+            cvals, ccols = fn(v2d, b.base, R, cols)
+            top_scores, c2 = self._select_topk(jnp.abs(cvals), b.max_sel)
+            # ONE packed gather for (value, column): interleave the
+            # values with the columns so the payload-scale random access
+            # is paid once, not twice (two take_along_axis remaps
+            # measured 0.99 ms EACH at VGG, device profile r5). The pack
+            # rides the INT32 domain — values bitcast to int32, columns
+            # native — because the reverse (columns bitcast to f32) puts
+            # small ints into subnormal f32 bit patterns, which the TPU
+            # flushes to zero in the gather (verified on-chip: every
+            # gathered column < 2^23 came back 0). Integer paths preserve
+            # bits; the value round-trip is exact (f32 up-cast of a bf16
+            # state value is exact, and bitcast is bijective).
+            packed = jnp.stack(
+                [jax.lax.bitcast_convert_type(
+                    cvals.astype(jnp.float32), jnp.int32), ccols],
+                axis=-1)                                   # [R, C, 2]
+            sel = jnp.take_along_axis(packed, c2[:, :, None], axis=1)
+            sel_vals = jax.lax.bitcast_convert_type(
+                sel[:, :, 0], jnp.float32).astype(cvals.dtype)
+            cols_sel = sel[:, :, 1].astype(self.index_dtype)
+        else:
+            # fallback (non-segment-aligned geometry): per-(row, lane)
+            # approx candidates over the 3-D view
+            v3 = vec_c[b.base:b.base + R * cols].reshape(R, nb, 128)
+            imp3 = jnp.abs(v3)
+            kp = min(nb, -(-self.SEL3D_MARGIN * b.max_sel // 128))
+            cv, ci = jax.lax.approx_max_k(imp3, kp, reduction_dimension=1,
+                                          recall_target=float(r))
+            cand = cv.reshape(R, kp * 128)             # [R, kp*128]
+            top_scores, c2 = self._select_topk(cand, b.max_sel)
+            lane = c2 % 128
+            blk = jnp.take_along_axis(ci.reshape(R, kp * 128), c2, axis=1)
+            cols_sel = blk.astype(self.index_dtype) * 128 + lane.astype(
+                self.index_dtype)
+            sel_vals = None
 
         if self.c.max_adaptation_iters > 0 and b.adapt.any():
             thr = _ladder_adapt_from_topk(
@@ -975,9 +1063,13 @@ class FlatDGCEngine:
                  & (cols_sel < numels))
         gidx = jnp.where(valid, row_off + cols_sel,
                          jnp.asarray(S, self.index_dtype))
-        # payload values via one small global gather from the flat buffer
-        # (the sentinel slot reads the structural 0.0)
-        vals = jnp.where(valid, vec_c[gidx], jnp.zeros((), vec_c.dtype))
+        if sel_vals is None:
+            # payload values via one small global gather from the flat
+            # buffer (the sentinel slot reads the structural 0.0)
+            vals = jnp.where(valid, vec_c[gidx],
+                             jnp.zeros((), vec_c.dtype))
+        else:
+            vals = jnp.where(valid, sel_vals, jnp.zeros((), vec_c.dtype))
         return vals, gidx
 
     def sparsify(self, vec_c: jax.Array, key: jax.Array):
@@ -1000,12 +1092,19 @@ class FlatDGCEngine:
             return (jnp.zeros((0,), vec_c.dtype),
                     jnp.zeros((0,), self.index_dtype))
         out_v, out_i = [], []
+        # ONE shared [T/128, 128] block view for every wide bucket's
+        # sampling gather and candidates kernel (XLA cannot CSE the
+        # reshape across nested-jit kernel calls; per-call copies cost
+        # ~2.5 ms/step at VGG, device profile r5)
+        v2d = (vec_c.reshape(-1, 128)
+               if any(self._use_seg_kernel(b) or self._use_3d(b)
+                      for b in self.buckets) else None)
         for bi, b in enumerate(self.buckets):
             k = jax.random.fold_in(key, bi)
             tight = jnp.asarray(b.tight)
-            if self._use_3d(b):
-                # wide buckets: the layout-free path — no 2-D relayout
-                vals, gidx = self._sparsify_bucket_3d(vec_c, b, k)
+            if self._use_seg_kernel(b) or self._use_3d(b):
+                # layout-free selection — no 2-D relayout of the bucket
+                vals, gidx = self._sparsify_bucket_3d(vec_c, v2d, b, k)
                 out_v.append(vals.reshape(-1)[tight])
                 out_i.append(gidx.reshape(-1)[tight])
                 continue

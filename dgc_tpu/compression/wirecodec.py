@@ -66,6 +66,14 @@ class IndexCodec:
         widths = np.maximum(
             1, np.ceil(np.log2(np.maximum(self.slot_numel, 2))).astype(
                 np.int64))
+        if widths.size and widths.max() > 32:
+            # a >2^32-element tensor row would need >32-bit locals — the
+            # uint32 two-word packing cannot carry it; refuse loudly
+            # instead of silently truncating (use the plain index wire
+            # there: packed_indices=False)
+            raise ValueError(
+                "packed_indices: tensor rows with numel > 2^32 exceed the "
+                f"32-bit local-index packing (max width {widths.max()})")
         self.widths = widths.astype(np.int32)
         bit_off = np.zeros(self.payload, np.int64)
         if self.payload:

@@ -139,6 +139,19 @@ def check_kernels():
     out["topk_rows"] = bool(
         np.array_equal(np.asarray(cv_), np.asarray(rv_))
         and np.array_equal(np.asarray(ci_), np.asarray(ri_)))
+
+    # segment-top-2 candidates (the r5 selection kernel) at a ResNet-50
+    # bucket geometry, base off zero so the BlockSpec offset arithmetic
+    # is exercised
+    span = kernels._SEG_BLOCKS * 128
+    base, rows, cols = span * 3, 3, span * 72      # [3, 2.36M]
+    vec = jnp.asarray(rng.randn(base + rows * cols + span), jnp.float32)
+    v2d = vec.reshape(-1, 128)
+    cvk, cck = kernels.seg_top2_candidates(v2d, base, rows, cols)
+    cvr, ccr = kernels.seg_top2_reference(v2d, base, rows, cols)
+    out["seg_top2_candidates"] = bool(
+        np.array_equal(np.asarray(cvk), np.asarray(cvr))
+        and np.array_equal(np.asarray(cck), np.asarray(ccr)))
     return out
 
 
@@ -196,14 +209,21 @@ def check_recall_3d(threshold: float = 0.95):
     rng = np.random.RandomState(3)
     out = {}
     for bi, b in enumerate(engine.buckets):
-        if not engine._use_3d(b):
+        if not (engine._use_seg_kernel(b) or engine._use_3d(b)):
             continue
         R, cols = b.rows, b.cols
         x = np.abs(rng.randn(R, cols)).astype(np.float32)
+        # row tails beyond a tensor's numel are STRUCTURAL ZEROS in the
+        # engine's flat buffer (ParamLayout.flatten) — the selection
+        # paths rely on that invariant (zero candidates never beat a
+        # positive threshold), so the driver must honor it
+        for r in range(R):
+            x[r, int(b.numels[r]):] = 0.0
         vec = np.zeros((layout.t_compressed,), np.float32)
         vec[b.base:b.base + R * cols] = x.reshape(-1)
         _, idx = jax.jit(
-            lambda vv, kk, b=b: engine._sparsify_bucket_3d(vv, b, kk))(
+            lambda vv, kk, b=b: engine._sparsify_bucket_3d(
+                vv, vv.reshape(-1, 128), b, kk))(
             jnp.asarray(vec), jax.random.PRNGKey(0))
         idx = np.asarray(idx)
         rec, fill = [], []

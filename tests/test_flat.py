@@ -1342,3 +1342,44 @@ def test_flat_packed_indices_with_int8(mesh8):
         np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_u),
                                    rtol=1e-6, atol=1e-7,
                                    err_msg=f"step {step}")
+
+
+def test_3d_seg_top2_kernel_selection_path(monkeypatch):
+    """The segment-top-2 candidates kernel path (cells >= 3*num_selects):
+    same payload invariants and near-exact CPU recall as the approx 3-D
+    path, with values taken from the kernel's candidate stream instead
+    of a payload gather."""
+    from dgc_tpu.compression.flat import FlatDGCEngine
+    from dgc_tpu.ops import kernels
+
+    monkeypatch.setattr(FlatDGCEngine, "SEL3D_MIN_COLS", 1024 * 1024)
+    numel = 1_200_000
+    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=0.01)
+    comp.initialize([("w", (numel, (numel,)))])
+    params = {"w": jax.ShapeDtypeStruct((numel,), jnp.float32)}
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+    layout, engine = dist.make_flat(params)
+    [b] = engine.buckets
+    assert engine._use_3d(b)
+    cells = (b.cols // 128 // kernels._SEG_BLOCKS) * 128
+    assert cells >= 3 * b.max_sel          # the kernel path engages
+    assert kernels.seg_top2_eligible(layout.t_compressed // 128, b.base,
+                                     b.cols)
+
+    a = comp.attributes["w"]
+    rng = np.random.RandomState(23)
+    vec = np.zeros((layout.t_compressed,), np.float32)
+    vec[:numel] = rng.randn(numel).astype(np.float32)
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+                                         jax.random.PRNGKey(0))
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    real = idx != layout.sentinel
+    count = int(real.sum())
+    assert 0.8 * a.num_selects * 0.9 <= count <= a.num_selects
+    assert (idx[real] < numel).all() and (idx[real] >= 0).all()
+    np.testing.assert_array_equal(vals[real], vec[idx[real]])
+    assert len(np.unique(idx[real])) == count
+    exact = set(np.argsort(-np.abs(vec[:numel]))[:count])
+    recall = len(exact & set(idx[real].tolist())) / count
+    assert recall >= 0.95, recall

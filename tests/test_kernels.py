@@ -347,3 +347,49 @@ def test_topk_rows_k_exceeding_cols_raises_like_lax():
     x = jnp.zeros((8, 100), jnp.float32)
     with pytest.raises(ValueError):
         topk_rows(x, 110)
+
+
+def test_seg_top2_kernel_matches_reference():
+    """seg_top2_candidates (interpret mode on CPU) == seg_top2_reference
+    bitwise — the same compiled-vs-reference contract every other kernel
+    carries (tpu_check.py re-asserts it compiled on the real chip). Runs
+    the pallas_call path explicitly, since the engine picks the reference
+    off-TPU and would otherwise leave the kernel body unexercised by CI.
+    Covers base != 0 (BlockSpec offset arithmetic), multi-row, ties, and
+    a structural-zero tail."""
+    from dgc_tpu.ops import kernels
+
+    span = kernels._SEG_BLOCKS * 128
+    rng = np.random.RandomState(7)
+    base, rows, cols = span, 2, 2 * span
+    vec = rng.randn(base + rows * cols + span).astype(np.float32)
+    vec[base + cols - span // 2:base + cols] = 0.0   # a zero tail region
+    # force ties inside one segment: equal |values| at two blocks
+    vec[base + 5 * 128 + 3] = 9.0
+    vec[base + 9 * 128 + 3] = -9.0
+    v2d = jnp.asarray(vec).reshape(-1, 128)
+    cvk, cck = kernels.seg_top2_candidates(v2d, base, rows, cols)
+    cvr, ccr = kernels.seg_top2_reference(v2d, base, rows, cols)
+    np.testing.assert_array_equal(np.asarray(cvk), np.asarray(cvr))
+    np.testing.assert_array_equal(np.asarray(cck), np.asarray(ccr))
+    # the tie resolved to the FIRST block (lax.top_k order) and the
+    # second slot holds the other of the pair
+    nseg = cols // span
+    cv4 = np.asarray(cvk).reshape(rows, nseg, 2, 128)
+    cc4 = np.asarray(cck).reshape(rows, nseg, 2, 128)
+    assert cv4[0, 0, 0, 3] == 9.0 and cv4[0, 0, 1, 3] == -9.0
+    assert cc4[0, 0, 0, 3] == 5 * 128 + 3
+    assert cc4[0, 0, 1, 3] == 9 * 128 + 3
+
+
+def test_seg_top2_eligible_bounds():
+    """Eligibility rejects regions that would read past the buffer end
+    (rows > 1 must be accounted for) and misaligned bases/widths."""
+    from dgc_tpu.ops import kernels
+
+    span = kernels._SEG_BLOCKS * 128
+    blocks = (4 * span) // 128
+    assert kernels.seg_top2_eligible(blocks, 0, span, rows=4)
+    assert not kernels.seg_top2_eligible(blocks, 0, span, rows=5)
+    assert not kernels.seg_top2_eligible(blocks, span + 128, span, rows=1)
+    assert not kernels.seg_top2_eligible(blocks, 0, span + 128, rows=1)
