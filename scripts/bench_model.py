@@ -33,6 +33,15 @@ def main():
                          "bfloat16 (configs/dgc/bf16mem.py)")
     ap.add_argument("--int8", action="store_true",
                     help="int8-quantized wire values (configs/dgc/int8.py)")
+    ap.add_argument("--no-int8-ef", action="store_true",
+                    help="with --int8: disable quantization error "
+                         "feedback (the round-3 no-feedback form) — "
+                         "isolates the feedback path's step-time cost")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bfloat16 model compute (configs/bf16.py): both "
+                         "arms build the model with dtype=bf16 and the "
+                         "step casts the flat parameter buffer once "
+                         "(build_train_step model_dtype)")
     ap.add_argument("--mode", default="scan", choices=["scan", "dispatch"],
                     help="scan: K steps in one lax.scan dispatch (the "
                          "conservative default — its while-loop carry "
@@ -54,7 +63,8 @@ def main():
                                   make_flat_state, shard_state)
     from dgc_tpu.utils.pytree import named_flatten
 
-    model = getattr(models, args.model)()
+    model = getattr(models, args.model)(
+        **({"dtype": jnp.bfloat16} if args.bf16 else {}))
     size = 32 if args.model.startswith("resnet2") else 224
     ncls = 10 if size == 32 else 1000
 
@@ -89,13 +99,16 @@ def main():
                             dist_opt=dist)
         step = build_train_step(model.apply, dist, mesh, donate=dispatch,
                                 use_dropout="vgg" in args.model,
-                                flat=setup)
+                                flat=setup,
+                                model_dtype=(jnp.bfloat16 if args.bf16
+                                             else None))
         loop = (make_dispatch_loop(step, args.k) if dispatch
                 else bench._make_k_loop(step, images, labels, args.k))
         return (loop, state), setup
 
     comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(
-        momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8)
+        momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8,
+        int8_error_feedback=not args.no_int8_ef)
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     dgc_run, setup = prepare(DistributedOptimizer(
         dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))

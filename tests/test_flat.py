@@ -1363,9 +1363,12 @@ def test_3d_seg_top2_kernel_selection_path(monkeypatch):
     [b] = engine.buckets
     assert engine._use_3d(b)
     cells = (b.cols // 128 // kernels._SEG_BLOCKS) * 128
-    assert cells >= 3 * b.max_sel          # the kernel path engages
+    assert cells >= 3 * b.max_sel
     assert kernels.seg_top2_eligible(layout.t_compressed // 128, b.base,
                                      b.cols)
+    # the ROUTING gate itself — sparsify must actually take the kernel
+    # path, not silently fall back to the approx 3-D form
+    assert engine._use_seg_kernel(b)
 
     a = comp.attributes["w"]
     rng = np.random.RandomState(23)

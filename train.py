@@ -51,6 +51,19 @@ def get_save_path(*config_paths, prefix="runs"):
     return os.path.join(prefix, fmt(memo))
 
 
+def _narrow_model_dtype(model):
+    """The model's sub-4-byte compute dtype, if any (configs/bf16.py sets
+    ``model.dtype = bfloat16``): the flat train step then makes ONE narrow
+    copy of the parameter buffer per micro-batch instead of letting XLA
+    materialize per-consumer weight conversions (training/step.py)."""
+    import jax.numpy as jnp
+
+    dt = getattr(model, "dtype", None)
+    if dt is not None and jnp.dtype(dt).itemsize < 4:
+        return dt
+    return None
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", nargs="+", required=True)
@@ -302,7 +315,8 @@ def main():
             step_fn = build_train_step(model.apply, dist, mesh,
                                        num_batches_per_step=nbps,
                                        use_dropout=use_dropout,
-                                       flat=flat_setup)
+                                       flat=flat_setup,
+                                       model_dtype=_narrow_model_dtype(model))
 
         ds = dataset["train"]
         t0 = time.time()
