@@ -1012,6 +1012,13 @@ class FlatDGCEngine:
             fn = (kernels.seg_top2_candidates if kernels.use_pallas()
                   else kernels.seg_top2_reference)
             cvals, ccols = fn(v2d, b.base, R, cols)
+            # the candidate top-k runs DIRECTLY on the [R, ~2*cells]
+            # array. A mid-stage per-lane approx reduction (shrinking the
+            # aggregation to the classic 2x-margin size before the sort)
+            # was built and measured: +0.6 ms/step at VGG — the extra
+            # PartialReduce + index remap cost more than the halved sort
+            # saves. Negative result, do not re-litigate without a new
+            # mechanism.
             top_scores, c2 = self._select_topk(jnp.abs(cvals), b.max_sel)
             # ONE packed gather for (value, column): interleave the
             # values with the columns so the payload-scale random access
