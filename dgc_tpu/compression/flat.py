@@ -898,12 +898,7 @@ class FlatDGCEngine:
         form's relayout-vs-remap trade, which the kernel does not pay."""
         nb = b.cols // 128
         cells = (nb // kernels._SEG_BLOCKS) * 128
-        m = self._mem
-        sdt = np.dtype(m.dtype) if (m is not None and m.dtype) else (
-            np.dtype(self.layout.dtype))
         return (self._sampled_strided_ok(b)
-                and sdt.itemsize == 4   # bf16 state: (2,128) out blocks
-                                        # under-fill the 16-sublane tile
                 and cells >= 3 * b.max_sel
                 and kernels.seg_top2_eligible(
                     self.T // 128, b.base, b.cols, b.rows))
@@ -1036,8 +1031,10 @@ class FlatDGCEngine:
                     cvals.astype(jnp.float32), jnp.int32), ccols],
                 axis=-1)                                   # [R, C, 2]
             sel = jnp.take_along_axis(packed, c2[:, :, None], axis=1)
+            # back to the pipeline dtype (exact round-trip: the kernel's
+            # f32 values are exact up-casts of a narrow state)
             sel_vals = jax.lax.bitcast_convert_type(
-                sel[:, :, 0], jnp.float32).astype(cvals.dtype)
+                sel[:, :, 0], jnp.float32).astype(vec_c.dtype)
             cols_sel = sel[:, :, 1].astype(self.index_dtype)
         else:
             # fallback (non-segment-aligned geometry): per-(row, lane)

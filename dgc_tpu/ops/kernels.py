@@ -664,7 +664,7 @@ def seg_top2_reference(v2d: jax.Array, base: int, rows: int, cols: int):
     lane) flattens in that order."""
     nseg = cols // (_SEG_BLOCKS * _LANE)
     v = v2d.reshape(-1)[base:base + rows * cols].reshape(
-        rows, nseg, _SEG_BLOCKS, _LANE)
+        rows, nseg, _SEG_BLOCKS, _LANE).astype(jnp.float32)
     a = jnp.abs(v)
     # top-2 along the segment axis, ties -> lowest block index
     m1 = jnp.max(a, axis=2)                                # [R, S, 128]
@@ -687,7 +687,10 @@ def seg_top2_reference(v2d: jax.Array, base: int, rows: int, cols: int):
 
 
 def _seg_top2_kernel(x_ref, v_ref, i_ref):
-    x = x_ref[...]                                         # [SEG, 128]
+    # narrow (bf16) inputs up-cast once in VMEM: the comparison math and
+    # the emitted values are f32 (exact for bf16), keeping the output
+    # blocks at the f32 tile shape regardless of the state dtype
+    x = x_ref[...].astype(jnp.float32)                     # [SEG, 128]
     a = jnp.abs(x)
     blk = jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
     m1 = jnp.max(a, axis=0, keepdims=True)                 # [1, 128]
@@ -735,7 +738,7 @@ def seg_top2_candidates(v2d: jax.Array, base: int, rows: int, cols: int):
         _seg_top2_kernel,
         grid=grid,
         out_shape=(
-            jax.ShapeDtypeStruct((rows * nseg, 2, _LANE), v2d.dtype),
+            jax.ShapeDtypeStruct((rows * nseg, 2, _LANE), jnp.float32),
             jax.ShapeDtypeStruct((rows * nseg, 2, _LANE), jnp.int32),
         ),
         in_specs=[pl.BlockSpec(

@@ -1344,17 +1344,21 @@ def test_flat_packed_indices_with_int8(mesh8):
                                    err_msg=f"step {step}")
 
 
-def test_3d_seg_top2_kernel_selection_path(monkeypatch):
+@pytest.mark.parametrize("state_dtype", [None, "bfloat16"])
+def test_3d_seg_top2_kernel_selection_path(monkeypatch, state_dtype):
     """The segment-top-2 candidates kernel path (cells >= 3*num_selects):
     same payload invariants and near-exact CPU recall as the approx 3-D
     path, with values taken from the kernel's candidate stream instead
-    of a payload gather."""
+    of a payload gather. Parameterized over the narrow (bf16)
+    error-feedback state: the kernel up-casts in VMEM and the engine
+    casts back, so the vals == vec[idx] round-trip must stay exact."""
     from dgc_tpu.compression.flat import FlatDGCEngine
     from dgc_tpu.ops import kernels
 
     monkeypatch.setattr(FlatDGCEngine, "SEL3D_MIN_COLS", 1024 * 1024)
     numel = 1_200_000
-    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
+    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9,
+                                                    dtype=state_dtype),
                          sample_ratio=0.01)
     comp.initialize([("w", (numel, (numel,)))])
     params = {"w": jax.ShapeDtypeStruct((numel,), jnp.float32)}
@@ -1372,17 +1376,21 @@ def test_3d_seg_top2_kernel_selection_path(monkeypatch):
 
     a = comp.attributes["w"]
     rng = np.random.RandomState(23)
+    vdt = jnp.bfloat16 if state_dtype else jnp.float32
     vec = np.zeros((layout.t_compressed,), np.float32)
     vec[:numel] = rng.randn(numel).astype(np.float32)
-    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec),
+    vec = np.asarray(jnp.asarray(vec, vdt).astype(jnp.float32))
+    vals, idx = jax.jit(engine.sparsify)(jnp.asarray(vec, vdt),
                                          jax.random.PRNGKey(0))
-    vals, idx = np.asarray(vals), np.asarray(idx)
+    assert vals.dtype == vdt
+    vals = np.asarray(vals.astype(jnp.float32))
+    idx = np.asarray(idx)
     real = idx != layout.sentinel
     count = int(real.sum())
     assert 0.8 * a.num_selects * 0.9 <= count <= a.num_selects
     assert (idx[real] < numel).all() and (idx[real] >= 0).all()
     np.testing.assert_array_equal(vals[real], vec[idx[real]])
     assert len(np.unique(idx[real])) == count
-    exact = set(np.argsort(-np.abs(vec[:numel]))[:count])
-    recall = len(exact & set(idx[real].tolist())) / count
-    assert recall >= 0.95, recall
+    exact = np.argsort(-np.abs(vec[:numel]))[:count]
+    recall = len(set(exact.tolist()) & set(idx[real].tolist())) / count
+    assert recall >= 0.93 if state_dtype else recall >= 0.95, recall

@@ -349,14 +349,16 @@ def test_topk_rows_k_exceeding_cols_raises_like_lax():
         topk_rows(x, 110)
 
 
-def test_seg_top2_kernel_matches_reference():
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_seg_top2_kernel_matches_reference(dtype):
     """seg_top2_candidates (interpret mode on CPU) == seg_top2_reference
     bitwise — the same compiled-vs-reference contract every other kernel
     carries (tpu_check.py re-asserts it compiled on the real chip). Runs
     the pallas_call path explicitly, since the engine picks the reference
     off-TPU and would otherwise leave the kernel body unexercised by CI.
-    Covers base != 0 (BlockSpec offset arithmetic), multi-row, ties, and
-    a structural-zero tail."""
+    Covers base != 0 (BlockSpec offset arithmetic), multi-row, ties, a
+    structural-zero tail, and the narrow (bf16) state input — both ends
+    up-cast in the same place, so outputs are f32 and bitwise equal."""
     from dgc_tpu.ops import kernels
 
     span = kernels._SEG_BLOCKS * 128
@@ -367,9 +369,10 @@ def test_seg_top2_kernel_matches_reference():
     # force ties inside one segment: equal |values| at two blocks
     vec[base + 5 * 128 + 3] = 9.0
     vec[base + 9 * 128 + 3] = -9.0
-    v2d = jnp.asarray(vec).reshape(-1, 128)
+    v2d = jnp.asarray(vec, dtype).reshape(-1, 128)
     cvk, cck = kernels.seg_top2_candidates(v2d, base, rows, cols)
     cvr, ccr = kernels.seg_top2_reference(v2d, base, rows, cols)
+    assert cvk.dtype == jnp.float32 and cvr.dtype == jnp.float32
     np.testing.assert_array_equal(np.asarray(cvk), np.asarray(cvr))
     np.testing.assert_array_equal(np.asarray(cck), np.asarray(ccr))
     # the tie resolved to the FIRST block (lax.top_k order) and the
