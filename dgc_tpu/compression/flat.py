@@ -220,11 +220,37 @@ class ParamLayout:
                                    self.dtype))
         return jnp.concatenate(parts)
 
-    def unflatten(self, flat: jax.Array):
-        """Flat [P] -> pytree with the original structure."""
+    def unflatten(self, flat: jax.Array, transform=None):
+        """Flat [P] -> pytree with the original structure. ``transform``
+        (name, array) -> array wraps each view as it is built (the train
+        step's per-tensor convert-hoisting guards, training/step.py)."""
         named = {n: flat[self.offsets[n]:self.offsets[n] + self.sizes[n]]
                  .reshape(self.shapes[n]) for n in self._tree_order}
+        if transform is not None:
+            named = {n: transform(n, a) for n, a in named.items()}
         return named_unflatten(named, self.treedef)
+
+    def convert_hoist_risky(self) -> frozenset:
+        """Compressed tensors whose flat-buffer view XLA can rewrite as
+        ``slice(reshape(P))`` — base offset AND the buffer total both
+        multiples of ``prod(shape[1:])``. Under auto-bf16 conv precision
+        the simplifier then hoists the weight convert over the WHOLE
+        buffer (see ``ops.kernels.opaque_view`` for the measured cost and
+        the fix). Only tensors much smaller than the buffer qualify: at
+        ``total < 4 * numel`` the whole-buffer convert costs about what
+        XLA's direct slice+convert does (it picks that form for VGG's
+        fc1, 74% of the buffer), while the guard's copy is pure
+        addition."""
+        out = set()
+        for n in self.compressed_names:
+            shape = self.shapes[n]
+            if len(shape) < 2 or self.total < 4 * self.sizes[n]:
+                continue
+            trailing = int(np.prod(shape[1:], dtype=np.int64))
+            if (trailing > 1 and self.offsets[n] % trailing == 0
+                    and self.total % trailing == 0):
+                out.add(n)
+        return frozenset(out)
 
     def unflatten_named(self, flat: jax.Array, keep_1d: bool = False):
         """Flat [P] -> {name: array} (layout order)."""

@@ -396,3 +396,43 @@ def test_seg_top2_eligible_bounds():
     assert not kernels.seg_top2_eligible(blocks, 0, span, rows=5)
     assert not kernels.seg_top2_eligible(blocks, span + 128, span, rows=1)
     assert not kernels.seg_top2_eligible(blocks, 0, span + 128, rows=1)
+
+
+def test_opaque_view_identity_and_grad():
+    """opaque_view is a bitwise identity with an identity backward —
+    the convert-hoisting guard must not change the differentiated
+    function (training/step.py's guarded unpack)."""
+    from dgc_tpu.ops import kernels
+
+    rng = np.random.RandomState(3)
+    for shape in [(3, 3, 64, 64), (13, 7), (1024,)]:
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        np.testing.assert_array_equal(np.asarray(kernels.opaque_view(x)),
+                                      np.asarray(x))
+        g = jax.grad(lambda a: jnp.sum(kernels.opaque_view(a) ** 2))(x)
+        np.testing.assert_array_equal(np.asarray(g), 2 * np.asarray(x))
+
+
+def test_opaque_view_from_matches_slice():
+    """opaque_view_from streams flat[base:base+numel] without an operand
+    slice; forward is bitwise the slice, backward is its exact transpose
+    (zeros + dynamic_update_slice), including under jit."""
+    from dgc_tpu.ops import kernels
+
+    rng = np.random.RandomState(4)
+    total = 64 * 1024
+    flat = jnp.asarray(rng.randn(total).astype(np.float32))
+    for base, numel in [(0, 1024), (2048, 3 * 1024), (31 * 1024, 33 * 1024)]:
+        assert kernels.opaque_view_eligible(total, base, numel)
+        out = kernels.opaque_view_from(flat, base, numel)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(flat[base:base + numel]))
+        g = jax.jit(jax.grad(
+            lambda f: jnp.sum(kernels.opaque_view_from(f, base, numel) ** 2)
+        ))(flat)
+        ref = np.zeros(total, np.float32)
+        ref[base:base + numel] = 2 * np.asarray(flat)[base:base + numel]
+        np.testing.assert_array_equal(np.asarray(g), ref)
+    # misalignment and overrun are rejected
+    assert not kernels.opaque_view_eligible(total, 128, 1024)
+    assert not kernels.opaque_view_eligible(total, total - 1024, 2048)
