@@ -571,6 +571,11 @@ class FlatDGCEngine:
             self._codec = IndexCodec(self.buckets)
         else:
             self._codec = None
+        #: any bucket selects through the segment-top-2 kernel: the TPU
+        #: compensate pass then emits the candidates itself
+        #: (kernels.fused_compensate_bits_cands) instead of a standalone
+        #: kernel re-reading the velocity it just wrote
+        self._seg_fused = any(self._use_seg_kernel(b) for b in self.buckets)
 
     # -------------------------------------------------------------- #
     # memory (fused over the flat buffers)                           #
@@ -624,14 +629,28 @@ class FlatDGCEngine:
                 "sent_bits": jnp.zeros((kernels.num_sent_words(T) if T else 0,),
                                        jnp.int32)}
 
-    def _compensate_acc(self, mmt, vec, grad, sent_bits=None):
+    def _compensate_acc(self, mmt, vec, grad, sent_bits=None,
+                        want_cands=False):
         """Momentum correction + local accumulation (memory.py:50-63) —
         the fused single-pass Pallas kernel on TPU, its jnp reference
         elsewhere (bit-compatible, tests/test_kernels.py). With
         ``sent_bits`` (the previous step's bit-packed transmit record,
         kernels.pack_sent_bits), the transmit mask (memory.py:72-77) is
         applied on read inside the same pass (deferred masking), expanded
-        from the packed words in VMEM.
+        from the packed words in VMEM. ``grad`` may be the WHOLE flat [P]
+        buffer (longer than the state): on the ``want_cands`` fused-kernel
+        path it is read through the kernel's index map with no ``[:T]``
+        operand-slice copy; every other path still slices to exactly [T]
+        (those kernels take exact-length operands).
+
+        ``want_cands`` (TPU bits path only): emit the segment-top-2
+        selection candidates from the same pass — the compensate kernel
+        is bandwidth-bound with an idle VPU, so candidate extraction
+        rides the stream instead of re-reading the velocity it just
+        wrote (kernels.fused_compensate_bits_cands). Returns
+        ``(comp, mmt', vec', cands_or_None)``; candidates are bitwise
+        the standalone kernel's, so the CPU/test path (cands=None,
+        seg_top2_reference downstream) stays equivalent.
 
         With a narrow (bf16) state dtype the compensated gradient is the
         bf16 velocity and the selection pipeline runs on it directly.
@@ -641,24 +660,35 @@ class FlatDGCEngine:
         K-loop state carry, not selection) and LOST 4.5 ms/step at VGG;
         reverted, recorded in docs/RESULTS.md.)"""
         m = self._mem
+        n = mmt.shape[0] if hasattr(mmt, "shape") else 0
         if m is None:
-            return grad, mmt, vec
+            return grad, mmt, vec, None
+        if (want_cands and sent_bits is not None and kernels.use_pallas()
+                and n > 0):
+            # the one no-slice path: the fused kernel reads [0, T) of a
+            # possibly-longer grad through its index map
+            mmt, vec, cv, ci = kernels.fused_compensate_bits_cands(
+                grad, mmt, vec, sent_bits, m.momentum, m.nesterov,
+                m.momentum_masking)
+            return vec, mmt, vec, (cv, ci)
+        # every other kernel/reference takes an exactly-[T] operand
+        g = grad if grad.shape[0] == n else grad[:n]
         if sent_bits is not None:
-            if kernels.use_pallas() and grad.shape[0] > 0:
+            if kernels.use_pallas() and n > 0:
                 mmt, vec = kernels.fused_compensate_bits(
-                    grad, mmt, vec, sent_bits, m.momentum, m.nesterov,
+                    g, mmt, vec, sent_bits, m.momentum, m.nesterov,
                     m.momentum_masking)
             else:
                 mmt, vec = kernels.fused_compensate_bits_reference(
-                    grad, mmt, vec, sent_bits, m.momentum, m.nesterov,
+                    g, mmt, vec, sent_bits, m.momentum, m.nesterov,
                     m.momentum_masking)
-        elif kernels.use_pallas() and grad.shape[0] > 0:
-            mmt, vec = kernels.fused_compensate(grad, mmt, vec, m.momentum,
+        elif kernels.use_pallas() and n > 0:
+            mmt, vec = kernels.fused_compensate(g, mmt, vec, m.momentum,
                                                 m.nesterov)
         else:
             mmt, vec = kernels.fused_compensate_reference(
-                grad, mmt, vec, m.momentum, m.nesterov)
-        return vec, mmt, vec
+                g, mmt, vec, m.momentum, m.nesterov)
+        return vec, mmt, vec, None
 
     def _clip_block(self, block: jax.Array, names: Sequence[str],
                     base: int) -> jax.Array:
@@ -986,7 +1016,7 @@ class FlatDGCEngine:
         return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
     def _sparsify_bucket_3d(self, vec_c: jax.Array, v2d: jax.Array,
-                            b: "_Bucket", k: jax.Array):
+                            b: "_Bucket", k: jax.Array, cands=None):
         """Layout-free selection over one wide bucket.
 
         The [R, cols] 2-D view is a PHYSICAL relayout of the flat buffer
@@ -1030,9 +1060,22 @@ class FlatDGCEngine:
             # values + columns come out of the stream, so no payload-
             # scale random gather afterwards (the r5 device profile
             # attributed ~6 ms/step at VGG to that chain)
-            fn = (kernels.seg_top2_candidates if kernels.use_pallas()
-                  else kernels.seg_top2_reference)
-            cvals, ccols = fn(v2d, b.base, R, cols)
+            span = kernels._SEG_BLOCKS * 128
+            if cands is not None:
+                # candidates already emitted by the fused compensate
+                # pass (bitwise the standalone kernel's): slice this
+                # bucket's contiguous segment range — candidate-scale
+                # data (~1/64 of the bucket), no [T]-scale re-read
+                cv_all, ci_all = cands
+                sb = b.base // span
+                nsr = cols // span
+                cvals = cv_all[sb:sb + R * nsr].reshape(R, -1)
+                ccols = kernels.seg_cols_local(
+                    ci_all[sb:sb + R * nsr].reshape(R, nsr, 2, 128))
+            else:
+                fn = (kernels.seg_top2_candidates if kernels.use_pallas()
+                      else kernels.seg_top2_reference)
+                cvals, ccols = fn(v2d, b.base, R, cols)
             # the candidate top-k runs DIRECTLY on the [R, ~2*cells]
             # array. A mid-stage per-lane approx reduction (shrinking the
             # aggregation to the classic 2x-margin size before the sort)
@@ -1101,8 +1144,13 @@ class FlatDGCEngine:
             vals = jnp.where(valid, sel_vals, jnp.zeros((), vec_c.dtype))
         return vals, gidx
 
-    def sparsify(self, vec_c: jax.Array, key: jax.Array):
+    def sparsify(self, vec_c: jax.Array, key: jax.Array, seg_cands=None):
         """Sampled-top-k selection over the compressed block [T].
+
+        ``seg_cands`` — optional ``(cand_vals, cand_blks)`` from the
+        fused compensate pass (kernels.fused_compensate_bits_cands);
+        seg-kernel buckets then slice their segments instead of
+        re-reading the flat buffer.
 
         Returns tight ``(values, indices)`` of length ``payload_size``;
         padded/invalid slots carry (0.0, sentinel) — the sentinel is the
@@ -1133,7 +1181,8 @@ class FlatDGCEngine:
             tight = jnp.asarray(b.tight)
             if self._use_seg_kernel(b) or self._use_3d(b):
                 # layout-free selection — no 2-D relayout of the bucket
-                vals, gidx = self._sparsify_bucket_3d(vec_c, v2d, b, k)
+                vals, gidx = self._sparsify_bucket_3d(vec_c, v2d, b, k,
+                                                      cands=seg_cands)
                 out_v.append(vals.reshape(-1)[tight])
                 out_i.append(gidx.reshape(-1)[tight])
                 continue
@@ -1343,20 +1392,31 @@ class FlatDGCEngine:
             mc = vc = md = None
 
         # --- compressed block: masked compensate -> sparsify -> gather ---
+        cands = None
         if m is not None:
             if clip is not None:
                 # clipping runs on the LOCAL gradient inside the accumulating
                 # compensate (reference memory.py:52-53)
                 gc = self._clip_block(gc, self.layout.compressed_names, 0)
+                gsrc = gc
+            else:
+                # the WHOLE flat buffer: on the fused-candidates TPU path
+                # the kernel reads [0, T) through its index map, so XLA
+                # never materializes the [:T] slice as a Pallas operand
+                # copy (part of the r5 device profile's data-movement-copy
+                # mass at VGG); non-fused paths slice inside
+                # _compensate_acc as before
+                gsrc = flat_grad
             # deferred masking (memory.py:72-77): the PREVIOUS step's
             # transmit record is applied on read inside the compensate
             # pass. x*0 == set-to-0 for finite values, and the sentinel
             # slot is a structural zero, so padded payload slots are no-ops.
-            comp, mc, vc = self._compensate_acc(mc, vc, gc,
-                                                mem["sent_bits"])
+            comp, mc, vc, cands = self._compensate_acc(
+                mc, vc, gsrc, mem["sent_bits"],
+                want_cands=self._seg_fused)
         else:
             comp = gc
-        values, indices = self.sparsify(comp, key)
+        values, indices = self.sparsify(comp, key, seg_cands=cands)
 
         dt = flat_grad.dtype
         int8_ef = False

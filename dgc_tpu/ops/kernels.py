@@ -38,6 +38,8 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["fused_compensate", "fused_compensate_reference",
            "fused_compensate_masked", "fused_compensate_masked_reference",
            "fused_compensate_bits", "fused_compensate_bits_reference",
+           "fused_compensate_bits_cands",
+           "fused_compensate_bits_cands_reference",
            "keep_from_sent", "pack_sent_bits", "keep_from_bits",
            "num_sent_words",
            "ladder_counts", "ladder_counts_reference",
@@ -339,16 +341,22 @@ def fused_compensate_bits_reference(grad, mmt, vec, bits, momentum: float,
     return om.astype(sdt), ov.astype(sdt)
 
 
-def _compensate_bits_kernel(g_ref, m_ref, v_ref, b_ref, om_ref, ov_ref, *,
-                            momentum, nesterov, momentum_masking):
+def _bits_compensate_core(g_ref, m_ref, v_ref, b_ref, *, momentum,
+                          nesterov, momentum_masking):
+    """Shared VMEM body of the bit-masked compensate kernels: in-VMEM
+    bit expansion + mask-on-read + momentum correction. ONE source of
+    truth so the plain kernel and the fused candidates kernel cannot
+    drift (their state outputs must stay bitwise identical — the fused
+    form's contract). Returns ``(mmt', vec')`` in the gradient dtype.
+
+    Bit expansion: word (a, l) -> rows a*32..a*32+31 of lane l. The
+    broadcast+reshape is sublane-local (the lane dim never moves),
+    which Mosaic legalizes; a jnp.repeat formulation and a 4-way-where
+    word select over a [rows, 4] word layout both failed to lower
+    (docs/RESULTS.md round-3 negative results)."""
     g = g_ref[:]
     rows = g.shape[0]
     b = b_ref[:]                                          # [rows//32, 128]
-    # in-VMEM bit expansion: word (a, l) -> rows a*32..a*32+31 of lane l.
-    # The broadcast+reshape is sublane-local (the lane dim never moves),
-    # which Mosaic legalizes; a jnp.repeat formulation and a 4-way-where
-    # word select over a [rows, 4] word layout both failed to lower
-    # (docs/RESULTS.md round-3 negative results).
     exp = jnp.broadcast_to(b[:, None, :], (rows // 32, 32, _LANE)).reshape(
         rows, _LANE)
     r = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANE), 0)
@@ -359,10 +367,19 @@ def _compensate_bits_kernel(g_ref, m_ref, v_ref, b_ref, om_ref, ov_ref, *,
     v0 = v_ref[:].astype(g.dtype) * keep
     if nesterov:
         m = (m0 + g) * momentum
-        ov_ref[:] = (v0 + m + g).astype(ov_ref.dtype)
+        ov = v0 + m + g
     else:
         m = momentum * m0 + g
-        ov_ref[:] = (v0 + m).astype(ov_ref.dtype)
+        ov = v0 + m
+    return m, ov
+
+
+def _compensate_bits_kernel(g_ref, m_ref, v_ref, b_ref, om_ref, ov_ref, *,
+                            momentum, nesterov, momentum_masking):
+    m, ov = _bits_compensate_core(g_ref, m_ref, v_ref, b_ref,
+                                  momentum=momentum, nesterov=nesterov,
+                                  momentum_masking=momentum_masking)
+    ov_ref[:] = ov.astype(ov_ref.dtype)
     om_ref[:] = m.astype(om_ref.dtype)
 
 
@@ -667,6 +684,23 @@ def seg_top2_eligible(total_blocks: int, base: int, cols: int,
             and (total_blocks * _LANE) >= base + rows * cols)
 
 
+def seg_cols_local(blks: jax.Array) -> jax.Array:
+    """Per-segment block indices -> bucket-local columns, flattened per
+    row. ``blks`` is [R, nseg, 2, 128] (the candidate layout every
+    seg-top-2 producer emits); the result is [R, nseg*2*128] in (seg,
+    slot, lane) order. ONE source of truth for the recomposition
+    ``(blk + seg*SEG_BLOCKS) * 128 + lane`` — the standalone kernel,
+    the jnp reference, and the engine's fused-candidates slice all route
+    through it, so the bitwise-parity contract between those paths
+    cannot drift."""
+    R, nseg = blks.shape[0], blks.shape[1]
+    lane = jnp.arange(_LANE, dtype=jnp.int32)
+    seg0 = (jnp.arange(nseg, dtype=jnp.int32)
+            * _SEG_BLOCKS)[None, :, None, None]
+    return ((blks + seg0) * _LANE
+            + lane[None, None, None, :]).reshape(R, -1)
+
+
 def seg_top2_reference(v2d: jax.Array, base: int, rows: int, cols: int):
     """jnp reference: per-(row, lane, segment) top-2 by |value| with
     first-occurrence ties, identical candidate order to the kernel.
@@ -690,32 +724,20 @@ def seg_top2_reference(v2d: jax.Array, base: int, rows: int, cols: int):
                   axis=2)
     v2 = jnp.take_along_axis(v, am2[:, :, None], axis=2)[:, :, 0]
     vals = jnp.stack([v1, v2], axis=2)                     # [R, S, 2, 128]
-    lane = jnp.arange(_LANE, dtype=jnp.int32)
-    seg0 = (jnp.arange(nseg, dtype=jnp.int32) * _SEG_BLOCKS)[None, :,
-                                                            None, None]
-    cols_local = ((seg0 + jnp.stack([am1, am2], axis=2)) * _LANE
-                  + lane[None, None, None, :])
-    return (vals.reshape(rows, -1), cols_local.reshape(rows, -1))
+    cols_local = seg_cols_local(jnp.stack([am1, am2], axis=2))
+    return (vals.reshape(rows, -1), cols_local)
 
 
 def _seg_top2_kernel(x_ref, v_ref, i_ref):
     # narrow (bf16) inputs up-cast once in VMEM: the comparison math and
     # the emitted values are f32 (exact for bf16), keeping the output
-    # blocks at the f32 tile shape regardless of the state dtype
+    # blocks at the f32 tile shape regardless of the state dtype.
+    # Cell math lives in _seg_top2_block, shared with the fused
+    # compensate+candidates kernel (bitwise-identical candidates).
     x = x_ref[...].astype(jnp.float32)                     # [SEG, 128]
-    a = jnp.abs(x)
-    blk = jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
-    m1 = jnp.max(a, axis=0, keepdims=True)                 # [1, 128]
-    am1 = jnp.min(jnp.where(a >= m1, blk, _SEG_BLOCKS), axis=0,
-                  keepdims=True)                           # [1, 128]
-    v1 = jnp.sum(jnp.where(blk == am1, x, 0.0), axis=0, keepdims=True)
-    a2 = jnp.where(blk == am1, -1.0, a)
-    m2 = jnp.max(a2, axis=0, keepdims=True)
-    am2 = jnp.min(jnp.where(a2 >= m2, blk, _SEG_BLOCKS), axis=0,
-                  keepdims=True)
-    v2 = jnp.sum(jnp.where(blk == am2, x, 0.0), axis=0, keepdims=True)
-    v_ref[...] = jnp.concatenate([v1, v2], axis=0)[None]   # [1, 2, 128]
-    i_ref[...] = jnp.concatenate([am1, am2], axis=0)[None]
+    v, i = _seg_top2_block(x)
+    v_ref[...] = v[None]                                   # [1, 2, 128]
+    i_ref[...] = i[None]
 
 
 @functools.partial(jax.jit,
@@ -766,13 +788,168 @@ def seg_top2_candidates(v2d: jax.Array, base: int, rows: int, cols: int):
         ),
         interpret=_interpret(),
     )(v2d)
-    lane = jnp.arange(_LANE, dtype=jnp.int32)
-    seg0 = (jnp.arange(nseg, dtype=jnp.int32)
-            * _SEG_BLOCKS)[None, :, None, None]
-    cols_local = ((blks.reshape(rows, nseg, 2, _LANE) + seg0) * _LANE
-                  + lane[None, None, None, :])
     return (vals.reshape(rows, -1),
-            cols_local.reshape(rows, -1))
+            seg_cols_local(blks.reshape(rows, nseg, 2, _LANE)))
+
+
+# ------------------------------------------------------------------ #
+# compensate + candidate extraction, one pass                        #
+# ------------------------------------------------------------------ #
+
+def _seg_top2_block(x):
+    """Per-(lane) top-2 by |value| of one [SEG_BLOCKS, 128] cell block —
+    the exact math of :func:`_seg_top2_kernel`, shared so the fused
+    compensate+candidates kernel emits bitwise-identical candidates.
+    Returns ([2, 128] signed values, [2, 128] local block indices)."""
+    a = jnp.abs(x)
+    blk = jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
+    m1 = jnp.max(a, axis=0, keepdims=True)                 # [1, 128]
+    am1 = jnp.min(jnp.where(a >= m1, blk, _SEG_BLOCKS), axis=0,
+                  keepdims=True)                           # [1, 128]
+    v1 = jnp.sum(jnp.where(blk == am1, x, 0.0), axis=0, keepdims=True)
+    a2 = jnp.where(blk == am1, -1.0, a)
+    m2 = jnp.max(a2, axis=0, keepdims=True)
+    am2 = jnp.min(jnp.where(a2 >= m2, blk, _SEG_BLOCKS), axis=0,
+                  keepdims=True)
+    v2 = jnp.sum(jnp.where(blk == am2, x, 0.0), axis=0, keepdims=True)
+    return (jnp.concatenate([v1, v2], axis=0),
+            jnp.concatenate([am1, am2], axis=0))
+
+
+def fused_compensate_bits_cands_reference(grad, mmt, vec, bits,
+                                          momentum: float, nesterov: bool,
+                                          momentum_masking: bool):
+    """jnp reference of the fused pass: compensate-with-bit-mask, then
+    per-(lane, segment) top-2 candidates over the STORED velocity (the
+    state-dtype round-trip makes narrow-state candidates match the
+    standalone :func:`seg_top2_reference` on the stored buffer exactly).
+    ``grad`` may be LONGER than the state (the engine passes the whole
+    flat [P] buffer so no [:T] slice is ever materialized); only the
+    first ``mmt.shape[0]`` elements participate. Returns candidates for
+    the ``n // span`` COMPLETE segments only — the compiled kernel's
+    output has ``grid * segments_per_block >= n // span`` rows whose
+    tail (straddling or grid-overhang segments) is unspecified, so
+    comparisons against this reference must slice the compiled output
+    to ``[:n // span]`` (see scripts/tpu_check.py); callers only ever
+    consume segments fully inside an eligible bucket, which end on
+    segment boundaries."""
+    n = mmt.shape[0]
+    om, ov = fused_compensate_bits_reference(grad[:n], mmt, vec, bits,
+                                             momentum, nesterov,
+                                             momentum_masking)
+    span = _SEG_BLOCKS * _LANE
+    nseg = n // span
+    x = ov[:nseg * span].astype(jnp.float32).reshape(nseg, _SEG_BLOCKS,
+                                                     _LANE)
+    cvs, cis = [], []
+    for s in range(nseg):
+        v, i = _seg_top2_block(x[s])
+        cvs.append(v)
+        cis.append(i)
+    cv = (jnp.stack(cvs) if cvs
+          else jnp.zeros((0, 2, _LANE), jnp.float32))
+    ci = (jnp.stack(cis) if cis
+          else jnp.zeros((0, 2, _LANE), jnp.int32))
+    return om, ov, cv, ci
+
+
+def _compensate_bits_cands_kernel(g_ref, m_ref, v_ref, b_ref, om_ref,
+                                  ov_ref, cv_ref, ci_ref, *, momentum,
+                                  nesterov, momentum_masking):
+    m, ov = _bits_compensate_core(g_ref, m_ref, v_ref, b_ref,
+                                  momentum=momentum, nesterov=nesterov,
+                                  momentum_masking=momentum_masking)
+    ov_ref[:] = ov.astype(ov_ref.dtype)
+    om_ref[:] = m.astype(om_ref.dtype)
+    # candidates read the STORED velocity value: one round-trip through
+    # the state dtype (no-op for f32) keeps them bitwise what the
+    # standalone kernel would read back from HBM
+    x_all = ov.astype(ov_ref.dtype).astype(jnp.float32)
+    rows = x_all.shape[0]
+    cvs, cis = [], []
+    for s in range(rows // _SEG_BLOCKS):
+        v, i = _seg_top2_block(x_all[s * _SEG_BLOCKS:(s + 1) * _SEG_BLOCKS])
+        cvs.append(v)
+        cis.append(i)
+    cv_ref[...] = jnp.stack(cvs)                          # [spb, 2, 128]
+    ci_ref[...] = jnp.stack(cis)
+
+
+@functools.partial(jax.jit, static_argnames=("momentum", "nesterov",
+                                             "momentum_masking"))
+def fused_compensate_bits_cands(grad: jax.Array, mmt: jax.Array,
+                                vec: jax.Array, bits: jax.Array,
+                                momentum: float, nesterov: bool = False,
+                                momentum_masking: bool = True):
+    """:func:`fused_compensate_bits` that ALSO emits the segment-top-2
+    selection candidates from the same pass.
+
+    Motivation (r5 device profile at VGG-16): the compensate kernel is
+    bandwidth-bound (five [T]-scale streams, VPU mostly idle) and the
+    standalone :func:`seg_top2_candidates` kernel re-reads the velocity
+    it just wrote — a full extra [T] stream plus its own kernel launch
+    (1.7 ms/step at VGG). Extracting the per-(lane, 256-block segment)
+    top-2 while the compensated block is still VMEM-resident removes
+    that stream; the candidate compute hides under the DMA waits.
+
+    Two deliberate signature deltas vs the plain kernel:
+
+    * ``grad`` may be LONGER than the state buffers — the engine passes
+      the whole flat [P] gradient so XLA never materializes the
+      ``flat_grad[:T]`` slice as a Pallas operand copy. Only rows
+      covering ``mmt.shape[0]`` are written back (ragged stores masked).
+    * returns ``(mmt', vec', cand_vals [NS, 2, 128] f32,
+      cand_blks [NS, 2, 128] int32)`` where NS covers every grid
+      block's segments. Segments past the last complete one (and any
+      grid-overhang tail) carry unspecified values — eligible buckets
+      end on segment boundaries (:func:`seg_top2_eligible`), so the
+      engine never reads them. Candidate (value, block) pairs are
+      bitwise :func:`seg_top2_candidates` on the stored velocity.
+
+    Alignment: the state length must tile the sublane group (the
+    engine's T is _ALIGN-aligned, so this never pads); ``grad`` length
+    must be lane-aligned (layout.total is _ALIGN-aligned)."""
+    n = mmt.shape[0]
+    assert vec.shape[0] == n and grad.shape[0] >= n, (grad.shape, n)
+    assert bits.shape[0] == num_sent_words(n), (bits.shape, n)
+    sub = _SUBLANE * (2 if min(grad.dtype.itemsize, mmt.dtype.itemsize,
+                               vec.dtype.itemsize) < 4 else 1)
+    assert n % (sub * _LANE) == 0, n
+    assert grad.shape[0] % _LANE == 0, grad.shape
+    rows = n // _LANE
+    g2 = grad.reshape(-1, _LANE)
+    m2, v2 = mmt.reshape(rows, _LANE), vec.reshape(rows, _LANE)
+    b2 = bits.reshape(-1, _LANE)
+
+    # blocks must hold whole 256-block segments AND whole 32-row word
+    # groups; the grid's ragged last block is masked for the state
+    # stores, candidate tails are unspecified (see docstring)
+    block_rows = min(_CHUNK_ROWS, _round_up(rows, _SEG_BLOCKS))
+    grid = pl.cdiv(rows, block_rows)
+    spb = block_rows // _SEG_BLOCKS
+    ns = grid * spb
+    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((block_rows // 32, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    cspec = pl.BlockSpec((spb, 2, _LANE), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+    om, ov, cv, ci = pl.pallas_call(
+        functools.partial(_compensate_bits_cands_kernel, momentum=momentum,
+                          nesterov=nesterov,
+                          momentum_masking=momentum_masking),
+        grid=(grid,),
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANE), mmt.dtype),
+                   jax.ShapeDtypeStruct((rows, _LANE), vec.dtype),
+                   jax.ShapeDtypeStruct((ns, 2, _LANE), jnp.float32),
+                   jax.ShapeDtypeStruct((ns, 2, _LANE), jnp.int32)),
+        in_specs=[spec, spec, spec, bspec],
+        out_specs=(spec, spec, cspec, cspec),
+        # in-place state update (see fused_compensate_bits)
+        input_output_aliases={1: 0, 2: 1},
+        interpret=_interpret(),
+    )(g2, m2, v2, b2)
+    return om.reshape(-1), ov.reshape(-1), cv, ci
 
 
 # ------------------------------------------------------------------ #

@@ -133,7 +133,7 @@ def main():
 
     def comp_stage(c):
         gg, m, vv = c
-        out, m2, v2 = engine._compensate_acc(m, vv, gg)
+        out, m2, v2, _ = engine._compensate_acc(m, vv, gg)
         return (gg * 0.999, m2, v2 * 0.5)
 
     time_scan(comp_stage, (gc, mc, vc), args.k, rtt, name="compensate [T]")

@@ -152,6 +152,28 @@ def check_kernels():
     out["seg_top2_candidates"] = bool(
         np.array_equal(np.asarray(cvk), np.asarray(cvr))
         and np.array_equal(np.asarray(cck), np.asarray(ccr)))
+
+    # fused compensate+candidates (the r5 final engine path): state
+    # bitwise the plain bits kernel AND candidates bitwise the reference
+    # composition, with a grad buffer LONGER than the state (the no-slice
+    # engine calling convention) and a tail past the last whole segment
+    nf = span * 16 + 2048
+    gf = jnp.asarray(rng.randn(nf + 4096), jnp.float32)
+    mf = jnp.asarray(rng.randn(nf), jnp.float32)
+    vf = jnp.asarray(rng.randn(nf), jnp.float32)
+    bitsf = kernels.pack_sent_bits(
+        jnp.asarray(rng.choice(nf, 8192, replace=False).astype(np.int32)),
+        nf)
+    cm, cv2, ccv, cci = kernels.fused_compensate_bits_cands(
+        gf, mf, vf, bitsf, 0.9, False, True)
+    rm, rv2, rcv, rci = kernels.fused_compensate_bits_cands_reference(
+        gf, mf, vf, bitsf, 0.9, False, True)
+    nseg = nf // span
+    out["fused_compensate_bits_cands"] = bool(
+        np.array_equal(np.asarray(cm), np.asarray(rm))
+        and np.array_equal(np.asarray(cv2), np.asarray(rv2))
+        and np.array_equal(np.asarray(ccv)[:nseg], np.asarray(rcv))
+        and np.array_equal(np.asarray(cci)[:nseg], np.asarray(rci)))
     return out
 
 

@@ -1344,6 +1344,46 @@ def test_flat_packed_indices_with_int8(mesh8):
                                    err_msg=f"step {step}")
 
 
+def test_sparsify_with_fused_candidates_matches_standalone(monkeypatch):
+    """The fused compensate+candidates path: ``sparsify(x, key,
+    seg_cands=...)`` with candidates from
+    ``kernels.fused_compensate_bits_cands`` must be BITWISE the
+    standalone seg-kernel path ``sparsify(x, key)`` — the engine swaps
+    where candidates come from, never what they are. Candidates for an
+    arbitrary x are obtained by feeding the fused kernel zero state and
+    zero bits (then ov == x exactly: m = momentum*0 + x, v = 0 + m)."""
+    from dgc_tpu.compression.flat import FlatDGCEngine
+    from dgc_tpu.ops import kernels
+
+    monkeypatch.setattr(FlatDGCEngine, "SEL3D_MIN_COLS", 1024 * 1024)
+    numel = 1_200_000
+    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=0.01)
+    comp.initialize([("w", (numel, (numel,)))])
+    params = {"w": jax.ShapeDtypeStruct((numel,), jnp.float32)}
+    dist = DistributedOptimizer(dgc_sgd(0.1), comp, world_size=1)
+    layout, engine = dist.make_flat(params)
+    [b] = engine.buckets
+    assert engine._use_seg_kernel(b) and engine._seg_fused
+
+    T = layout.t_compressed
+    rng = np.random.RandomState(31)
+    x = np.zeros((T,), np.float32)
+    x[:numel] = rng.randn(numel).astype(np.float32)
+    xj = jnp.asarray(x)
+    z = jnp.zeros((T,), jnp.float32)
+    bits = jnp.zeros((kernels.num_sent_words(T),), jnp.int32)
+    _, ov, cv, ci = kernels.fused_compensate_bits_cands(
+        xj, z, z, bits, 0.9, False, True)
+    np.testing.assert_array_equal(np.asarray(ov), x)
+    key = jax.random.PRNGKey(5)
+    v0, i0 = jax.jit(engine.sparsify)(xj, key)
+    v1, i1 = jax.jit(lambda a, k, c: engine.sparsify(a, k, seg_cands=c))(
+        xj, key, (cv, ci))
+    np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
 @pytest.mark.parametrize("state_dtype", [None, "bfloat16"])
 def test_3d_seg_top2_kernel_selection_path(monkeypatch, state_dtype):
     """The segment-top-2 candidates kernel path (cells >= 3*num_selects):

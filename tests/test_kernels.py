@@ -385,6 +385,87 @@ def test_seg_top2_kernel_matches_reference(dtype):
     assert cc4[0, 0, 1, 3] == 9 * 128 + 3
 
 
+@pytest.mark.parametrize("nesterov,masking", [(False, True), (True, False)])
+@pytest.mark.parametrize("sdt", [jnp.float32, jnp.bfloat16])
+def test_fused_compensate_bits_cands_matches_composition(nesterov, masking,
+                                                         sdt):
+    """The fused compensate+candidates kernel (interpret mode on CPU) ==
+    (fused_compensate_bits_reference, then seg_top2_reference over the
+    stored velocity) bitwise — state updates AND candidates. Covers a
+    grad buffer LONGER than the state (the engine passes the whole flat
+    [P] so no [:T] operand slice is materialized), the bf16 state
+    round-trip, and both compensate variants."""
+    from dgc_tpu.ops import kernels
+
+    span = kernels._SEG_BLOCKS * 128
+    n = 3 * span                       # 3 complete segments
+    rng = np.random.RandomState(11)
+    grad = jnp.asarray(rng.randn(n + 2048).astype(np.float32))
+    mmt = jnp.asarray(rng.randn(n).astype(np.float32), sdt)
+    vec = jnp.asarray(rng.randn(n).astype(np.float32), sdt)
+    idx = jnp.asarray(rng.choice(n, 500, replace=False).astype(np.int32))
+    bits = kernels.pack_sent_bits(idx, n)
+
+    om, ov, cv, ci = kernels.fused_compensate_bits_cands(
+        grad, mmt, vec, bits, 0.9, nesterov, masking)
+    # the state contract: bitwise the plain bits KERNEL this fused form
+    # replaces (kernel-vs-jnp-reference parity for the compensate math is
+    # the plain kernel's own test; at some sizes XLA CPU's fusion of the
+    # nesterov multiply-add chain differs by ULPs between the two
+    # programs, a pre-existing interpret-mode wobble that the engine
+    # never sees: CPU runs the references, TPU runs the kernels and
+    # tpu_check pins compiled==interpret)
+    omr, ovr = kernels.fused_compensate_bits(
+        grad[:n], mmt, vec, bits, 0.9, nesterov, masking)
+    np.testing.assert_array_equal(np.asarray(om), np.asarray(omr))
+    np.testing.assert_array_equal(np.asarray(ov), np.asarray(ovr))
+    # candidates == the standalone kernel's over the STORED velocity,
+    # viewed as one row spanning the whole region
+    cvr, ccr = kernels.seg_top2_reference(ovr.reshape(-1, 128), 0, 1, n)
+    nseg = n // span
+    cv_flat = np.asarray(cv[:nseg]).reshape(1, -1)
+    np.testing.assert_array_equal(cv_flat, np.asarray(cvr))
+    # reference emits bucket-local columns; the fused kernel emits
+    # per-segment block indices — recompose and compare
+    lane = np.arange(128, dtype=np.int32)
+    seg0 = (np.arange(nseg, dtype=np.int32)
+            * kernels._SEG_BLOCKS)[None, :, None, None]
+    cols = ((np.asarray(ci[:nseg]).reshape(1, nseg, 2, 128) + seg0) * 128
+            + lane[None, None, None, :]).reshape(1, -1)
+    np.testing.assert_array_equal(cols, np.asarray(ccr))
+
+
+def test_fused_compensate_bits_cands_ragged_tail():
+    """A state length that is NOT a whole number of segments: the state
+    update must still be exact over all of [0, n); candidate segments
+    fully inside the data must match the standalone reference (the
+    straddling tail segment is unspecified and unused by the engine —
+    eligible buckets end on segment boundaries)."""
+    from dgc_tpu.ops import kernels
+
+    span = kernels._SEG_BLOCKS * 128
+    n = span + 16 * 128                # one complete segment + a tail
+    rng = np.random.RandomState(3)
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    mmt = jnp.asarray(rng.randn(n).astype(np.float32))
+    vec = jnp.asarray(rng.randn(n).astype(np.float32))
+    bits = kernels.pack_sent_bits(
+        jnp.asarray(rng.choice(n, 64, replace=False).astype(np.int32)), n)
+    om, ov, cv, ci = kernels.fused_compensate_bits_cands(
+        grad, mmt, vec, bits, 0.9, False, True)
+    omr, ovr = kernels.fused_compensate_bits_reference(
+        grad, mmt, vec, bits, 0.9, False, True)
+    np.testing.assert_array_equal(np.asarray(om), np.asarray(omr))
+    np.testing.assert_array_equal(np.asarray(ov), np.asarray(ovr))
+    cvr, ccr = kernels.seg_top2_reference(ovr.reshape(-1, 128), 0, 1, span)
+    np.testing.assert_array_equal(np.asarray(cv[0]).reshape(1, -1),
+                                  np.asarray(cvr))
+    lane = np.arange(128, dtype=np.int32)
+    cols = (np.asarray(ci[0]).reshape(1, 2, 128) * 128
+            + lane[None, None, :]).reshape(1, -1)
+    np.testing.assert_array_equal(cols, np.asarray(ccr))
+
+
 def test_seg_top2_eligible_bounds():
     """Eligibility rejects regions that would read past the buffer end
     (rows > 1 must be accounted for) and misaligned bases/widths."""
