@@ -312,6 +312,11 @@ class _Bucket(NamedTuple):
 _SPLIT_COLS = 8 * 1024 * 1024
 _SPLIT_TARGET = 4 * 1024 * 1024
 
+#: maximum wire-payload growth a bucket may pay to make its payload the
+#: full [R, max_sel] selection grid (identity ``tight`` map — both
+#: payload-scale compaction gathers skipped; see _bucket_from_rows)
+_PAD_PAYLOAD_MAX_FRAC = 0.02
+
 
 def _segment_rows(name, attrs, base, cols, sample_ratio, compress_ratio):
     """Split one giant tensor row into S segment rows: returns
@@ -369,7 +374,23 @@ def _build_buckets(attributes, layout: ParamLayout,
 
 def _bucket_from_rows(base: int, cols: int, rows) -> _Bucket:
     """Assemble a :class:`_Bucket` from per-row tuples
-    ``(row_off, numel, stride, num_samples, topk_samples, num_selects)``."""
+    ``(row_off, numel, stride, num_samples, topk_samples, num_selects)``.
+
+    The bucket's wire payload is normally the TIGHT concatenation of each
+    row's ``num_selects`` slots, extracted from the selection's
+    [R, max_sel] grid by the static ``tight`` gather. When the rows'
+    quotas are nearly uniform (the VGG fc segments: equal splits ±1) that
+    gather moves payload-scale data to drop almost nothing — so when
+    padding the payload to the full [R * max_sel] grid would grow the
+    wire by at most ``_PAD_PAYLOAD_MAX_FRAC``, the payload IS the grid:
+    ``tight`` becomes the identity, sparsify skips both payload-scale
+    compaction gathers (values + indices), and the extra slots ride the
+    wire as structural no-ops ((0.0, sentinel) — the scatter-add
+    contract, SURVEY.md §2.5). Real transmitted elements per tensor stay
+    <= num_selects either way (the reference's contract,
+    compression.py:151); only the fixed wire shape grows, bounded by the
+    gate (measured +0.1% at VGG's fc buckets vs ~1 ms of gathers; tight
+    ResNet-20 buckets would inflate 35% and keep the gather)."""
     cols_in = list(zip(*rows))
     # offsets can exceed int32 at the int64-wire scale; the rest are
     # tensor-local and always fit
@@ -378,9 +399,15 @@ def _bucket_from_rows(base: int, cols: int, rows) -> _Bucket:
         np.array(c, np.int32) for c in cols_in[1:])
     num_selects = selects
     max_sel = int(num_selects.max())
-    tight = np.concatenate([
-        np.arange(r * max_sel, r * max_sel + k, dtype=np.int64)
-        for r, k in enumerate(num_selects)])
+    n_rows_ = len(rows)
+    padded = n_rows_ * max_sel
+    if padded - int(num_selects.sum()) <= (
+            _PAD_PAYLOAD_MAX_FRAC * int(num_selects.sum())):
+        tight = np.arange(padded, dtype=np.int64)
+    else:
+        tight = np.concatenate([
+            np.arange(r * max_sel, r * max_sel + k, dtype=np.int64)
+            for r, k in enumerate(num_selects)])
     stride_groups = []
     n_rows = len(rows)
     r0 = 0
@@ -405,7 +432,7 @@ def _bucket_from_rows(base: int, cols: int, rows) -> _Bucket:
         adapt=numels > samples,
         exact=bool((samples >= numels).all()),
         tight=tight,
-        payload=int(num_selects.sum()),
+        payload=int(tight.shape[0]),
         stride_groups=tuple(stride_groups),
     )
 
@@ -546,8 +573,12 @@ class FlatDGCEngine:
         self.buckets = (_build_buckets(compressor.attributes, layout,
                                        compressor)
                         if compressor.compress_ratio < 1.0 else [])
-        #: per-worker wire payload in elements — matches the reference's
-        #: sum of per-tensor num_selects exactly (compression.py:151)
+        #: per-worker wire payload in elements — the reference's sum of
+        #: per-tensor num_selects (compression.py:151), plus at most
+        #: _PAD_PAYLOAD_MAX_FRAC of structural no-op slots per bucket
+        #: whose payload is the padded [R, max_sel] grid
+        #: (_bucket_from_rows; real transmitted elements per tensor stay
+        #: <= num_selects either way)
         self.payload_size = sum(b.payload for b in self.buckets)
         #: int8 wire (compressor.int8_values): payload position -> tensor
         #: row (static, payload order = rows in bucket order, num_selects
@@ -555,10 +586,13 @@ class FlatDGCEngine:
         #: scale wire is one f32 per row — negligible next to the payload
         self.payload_rows = sum(b.rows for b in self.buckets)
         if getattr(compressor, "int8_values", False) and self.payload_size:
+            # per payload slot: owning tensor row — derived from the
+            # bucket's tight map (slot s of the [R, max_sel] grid belongs
+            # to row s // max_sel), so it is correct for both the tight
+            # and the padded-payload layouts (_bucket_from_rows)
             rm, base = [], 0
             for b in self.buckets:
-                for r, ns in enumerate(b.num_selects):
-                    rm.append(np.full(int(ns), base + r, np.int32))
+                rm.append((b.tight // b.max_sel).astype(np.int32) + base)
                 base += b.rows
             self._row_map = jnp.asarray(np.concatenate(rm))
         else:
@@ -1176,15 +1210,24 @@ class FlatDGCEngine:
         v2d = (vec_c.reshape(-1, 128)
                if any(self._use_seg_kernel(b) or self._use_3d(b)
                       for b in self.buckets) else None)
+        def emit(vals, gidx, b):
+            # identity tight map (padded payload, _bucket_from_rows):
+            # the [R, max_sel] grid IS the payload — no compaction gather
+            if b.payload == b.rows * b.max_sel:
+                out_v.append(vals.reshape(-1))
+                out_i.append(gidx.reshape(-1))
+            else:
+                tight = jnp.asarray(b.tight)
+                out_v.append(vals.reshape(-1)[tight])
+                out_i.append(gidx.reshape(-1)[tight])
+
         for bi, b in enumerate(self.buckets):
             k = jax.random.fold_in(key, bi)
-            tight = jnp.asarray(b.tight)
             if self._use_seg_kernel(b) or self._use_3d(b):
                 # layout-free selection — no 2-D relayout of the bucket
                 vals, gidx = self._sparsify_bucket_3d(vec_c, v2d, b, k,
                                                       cands=seg_cands)
-                out_v.append(vals.reshape(-1)[tight])
-                out_i.append(gidx.reshape(-1)[tight])
+                emit(vals, gidx, b)
                 continue
             R = b.rows
             row_off = jnp.asarray(b.row_offsets,
@@ -1219,8 +1262,7 @@ class FlatDGCEngine:
                 vals = jnp.where(valid,
                                  jnp.take_along_axis(block, cols, axis=1),
                                  jnp.zeros((), vec_c.dtype))
-                out_v.append(vals.reshape(-1)[tight])
-                out_i.append(gidx.reshape(-1)[tight])
+                emit(vals, gidx, b)
                 continue
 
             # --- sampling positions (reference compression.py:113-121) ---
@@ -1286,8 +1328,7 @@ class FlatDGCEngine:
             vals = jnp.where(valid, jnp.take_along_axis(block, cols, axis=1),
                              jnp.zeros((), vec_c.dtype))
 
-            out_v.append(vals.reshape(-1)[tight])
-            out_i.append(gidx.reshape(-1)[tight])
+            emit(vals, gidx, b)
         return jnp.concatenate(out_v), jnp.concatenate(out_i)
 
     # -------------------------------------------------------------- #

@@ -51,10 +51,14 @@ class IndexCodec:
     def __init__(self, buckets):
         offs, numels = [], []
         for b in buckets:
-            for r in range(b.rows):
-                ns = int(b.num_selects[r])
-                offs.append(np.full(ns, int(b.row_offsets[r]), np.int64))
-                numels.append(np.full(ns, int(b.numels[r]), np.int64))
+            # per-slot owning row from the bucket's tight map (slot s of
+            # the [R, max_sel] grid -> row s // max_sel): correct for the
+            # tight AND the padded-payload layouts (flat._bucket_from_rows
+            # — padded slots belong to their grid row and decode in-row,
+            # safe because their wire value is exactly 0.0)
+            rows = np.asarray(b.tight) // b.max_sel
+            offs.append(np.asarray(b.row_offsets, np.int64)[rows])
+            numels.append(np.asarray(b.numels, np.int64)[rows])
         if offs:
             self.slot_off = np.concatenate(offs)
             self.slot_numel = np.concatenate(numels)
