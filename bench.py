@@ -55,6 +55,7 @@ session's draw), and the per-regime sub-objects.
 """
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -212,7 +213,14 @@ def main():
 
     # --- DGC at the north-star 0.1% ratio (flat fused engine) vs the
     #     dense baseline with the identical step shape, interleaved ---
-    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9))
+    # DGC_FUSED_APPLY=1 switches the apply epilogue to the fused Pallas
+    # pass (kernels.payload_apply_bits) so the same paired methodology
+    # A/Bs it against the default XLA scatter run
+    fused_apply = os.environ.get("DGC_FUSED_APPLY", "") == "1"
+    if fused_apply:
+        print("fused apply epilogue: ON", file=sys.stderr)
+    comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
+                         fused_apply=fused_apply)
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     dgc_run, dgc_setup = prepare(DistributedOptimizer(
         dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))

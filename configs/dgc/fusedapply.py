@@ -1,0 +1,20 @@
+"""Flag module: fused payload-apply epilogue (opt-in).
+
+Routes the post-allgather apply through ``kernels.payload_apply_bits``:
+one streamed Pallas pass that scatter-adds the decompressed payload
+into the fresh dense accumulator AND bit-packs this worker's transmit
+record, instead of the separate XLA scatter streams. Bitwise-equal to
+the fallback (tests/test_flat.py pins engine parity at W=8 including
+cross-worker duplicate coordinates); the engine silently falls back for
+int8 error-feedback wires, non-f32 payloads, a lane-misaligned T, or —
+off-TPU only — payloads past the interpret-mode oracle's budget (the
+interpreter runs the RMW loop serially; real scale stays on XLA there).
+A/B it paired with ``scripts/bench_model.py --fused-apply`` or
+``DGC_FUSED_APPLY=1 python bench.py``. Composes with `packidx.py` and
+`bf16mem.py`; with `int8.py` it only takes effect alongside
+``--train.compression.int8_error_feedback False``.
+"""
+
+from dgc_tpu.utils.config import configs
+
+configs.train.compression.fused_apply = True

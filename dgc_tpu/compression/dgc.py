@@ -94,8 +94,20 @@ class DGCCompressor(Compressor):
                  int8_values: bool = False,
                  int8_error_feedback: bool = True,
                  packed_indices: bool = False,
+                 fused_apply: bool = False,
                  approx_recall: float = 0.90, verbose: bool = False):
         self.fp16_values = fp16_values
+        #: fused apply epilogue (flat engine only): after the gathers,
+        #: decompress scatter-add + transmit-record pack run as ONE
+        #: streamed Pallas pass over the flat buffer
+        #: (kernels.payload_apply_bits) instead of two separate
+        #: [T]-scale XLA scatters; numerics within f32 scatter-order
+        #: rounding of the XLA path (bitwise for the transmit record and
+        #: for single-contribution coordinates). Off by default pending
+        #: the paired on-chip A/B (docs/RESULTS.md); the engine falls
+        #: back to the XLA path off-TPU, for non-f32 wires, and under
+        #: int8 error feedback.
+        self.fused_apply = fused_apply
         #: int8-quantized wire values with one f32 scale per TENSOR
         #: (scale = max|payload|/127, round-to-nearest, symmetric):
         #: addresses the reference's own stated caveat — "no

@@ -29,7 +29,9 @@ flat HBM-resident buffers and run the pipeline over them **fused**:
 Numerics follow the same contract as the per-tensor path
 (``dgc_tpu.compression.dgc``, ``dgc_tpu.ops.sparsify``): per-tensor sampled
 thresholds, bounded adaptation, fixed ``num_selects`` payload per tensor (the
-wire volume matches the reference's exactly), scatter-add-then-average
+wire volume stays within 2% of the reference's — the padded-payload gate
+``_PAD_PAYLOAD_MAX_FRAC`` may inflate near-tight buckets by up to 2% to buy
+an identity index map, never shrink them), scatter-add-then-average
 decompress, momentum correction and masking per SURVEY.md §2.3-2.5.
 """
 
@@ -993,6 +995,26 @@ class FlatDGCEngine:
                 and kernels.seg_top2_eligible(
                     self.T // 128, b.base, b.cols, b.rows))
 
+    def _use_fused_apply(self, m, int8_ef: bool, dt) -> bool:
+        """Whether the post-gather epilogue takes the fused Pallas
+        apply (kernels.payload_apply_bits) instead of the two XLA
+        scatters: opt-in (``DGCCompressor(fused_apply=True)``), needs a
+        transmit record to build (``m``), a plain f32 value wire (the
+        kernel accumulates in f32; int8 error feedback keeps its empty
+        record + eager masking), and a lane-aligned T (always true for
+        the layout's _ALIGN). Runs interpreted off-TPU — the CPU oracle
+        the parity tests pin — but only up to a small payload: the
+        interpreter executes the per-entry RMW loop serially (~0.3 ms
+        per wire entry on CPU — minutes per step at warmup-ratio
+        payloads), so at real scale off-TPU the engine silently keeps
+        the XLA scatter path."""
+        if kernels._interpret() and self.payload_size > 4096:
+            return False
+        return (getattr(self.c, "fused_apply", False)
+                and m is not None and not int8_ef
+                and dt == jnp.float32
+                and self.T % kernels._LANE == 0)
+
     def _sample_rows_3d(self, b: "_Bucket", v2d: jax.Array,
                         k: jax.Array) -> jax.Array:
         """Lane-block strided samples from the layout-free [R, nb, 128]
@@ -1103,6 +1125,11 @@ class FlatDGCEngine:
                 cv_all, ci_all = cands
                 sb = b.base // span
                 nsr = cols // span
+                # fail fast if the candidate stream doesn't cover this
+                # bucket's segment range (e.g. a [T]-sized stream zipped
+                # with a longer layout, or a misaligned b.base)
+                assert cv_all.shape[0] * span >= b.base + R * cols, (
+                    cv_all.shape, b.base, R, cols)
                 cvals = cv_all[sb:sb + R * nsr].reshape(R, -1)
                 ccols = kernels.seg_cols_local(
                     ci_all[sb:sb + R * nsr].reshape(R, nsr, 2, 128))
@@ -1530,17 +1557,39 @@ class FlatDGCEngine:
         wire = g_values.reshape(-1).astype(dt)
         if op == "average":
             wire = wire / world_size
-        acc = jnp.zeros((T,), dt).at[g_indices.reshape(-1)].add(wire)
-        if m is not None:
-            # THIS step's transmit record for the next compensate:
-            # bit-packed, one word-wide scatter over a 32x smaller buffer
-            # (padded slots carry the sentinel and are dropped — their
-            # repeated single-bit adds would carry across bits). Under
-            # int8 error feedback the record stays empty — masking was
-            # applied eagerly above and the velocity keeps the residual.
-            new_bits = (jnp.zeros_like(mem["sent_bits"]) if int8_ef
-                        else kernels.pack_sent_bits(
-                            indices, T, sentinel=self.layout.sentinel))
+        if self._use_fused_apply(m, int8_ef, dt):
+            # fused apply epilogue (kernels.payload_apply_bits): the
+            # decompress scatter-add AND the transmit-record pack ride
+            # one streamed Pallas pass over [T] — the payload is
+            # pre-bucketed by 2048-row chunk at payload scale, then each
+            # VMEM-resident chunk takes its entries' adds and bit sets
+            # and is written once. The LOCAL worker's non-sentinel
+            # entries are flagged inside the gathered stream, so the
+            # record is identical (bitwise) to pack_sent_bits on the
+            # local indices; the dead previous-step record buffer is
+            # donated for the rebuild (input_output_aliases). Values
+            # within f32 scatter-order rounding of the XLA path below.
+            me = jax.lax.axis_index(axis_name)
+            rows = jnp.arange(g_indices.shape[0],
+                              dtype=jnp.int32)[:, None]
+            flags = ((rows == me)
+                     & (g_indices != self.layout.sentinel)).reshape(-1)
+            acc, new_bits = kernels.payload_apply_bits(
+                wire, g_indices.reshape(-1), flags, T,
+                bits_donor=mem["sent_bits"])
+        else:
+            acc = jnp.zeros((T,), dt).at[g_indices.reshape(-1)].add(wire)
+            if m is not None:
+                # THIS step's transmit record for the next compensate:
+                # bit-packed, one word-wide scatter over a 32x smaller
+                # buffer (padded slots carry the sentinel and are dropped
+                # — their repeated single-bit adds would carry across
+                # bits). Under int8 error feedback the record stays empty
+                # — masking was applied eagerly above and the velocity
+                # keeps the residual.
+                new_bits = (jnp.zeros_like(mem["sent_bits"]) if int8_ef
+                            else kernels.pack_sent_bits(
+                                indices, T, sentinel=self.layout.sentinel))
 
         # --- dense fallback block: one collective + correction ---
         if P > T:

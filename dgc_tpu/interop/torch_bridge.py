@@ -22,6 +22,7 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from dgc_tpu.utils.compat import shard_map
 
 __all__ = ["TorchDGCBridge"]
 
@@ -82,7 +83,7 @@ class TorchDGCBridge:
                 out, m = self.engine.exchange(fg, m, k, axis, world)
                 return out, jax.tree.map(lambda x: x[None], m)
 
-            return jax.shard_map(
+            return shard_map(
                 worker, mesh=self.mesh,
                 in_specs=(P(axis), P(axis), P()),
                 out_specs=(P(), P(axis)),

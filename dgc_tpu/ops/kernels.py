@@ -45,7 +45,8 @@ __all__ = ["fused_compensate", "fused_compensate_reference",
            "ladder_counts", "ladder_counts_reference",
            "topk_rows", "topk_rows_reference",
            "seg_top2_candidates", "seg_top2_reference",
-           "seg_top2_eligible", "opaque_view", "use_pallas"]
+           "seg_top2_eligible", "opaque_view", "use_pallas",
+           "payload_apply_bits", "payload_apply_bits_reference"]
 
 _LANE = 128          # TPU lane width
 _SUBLANE = 8         # f32 sublane
@@ -925,6 +926,9 @@ def fused_compensate_bits_cands(grad: jax.Array, mmt: jax.Array,
     # groups; the grid's ragged last block is masked for the state
     # stores, candidate tails are unspecified (see docstring)
     block_rows = min(_CHUNK_ROWS, _round_up(rows, _SEG_BLOCKS))
+    # _CHUNK_ROWS is a multiple of _SEG_BLOCKS today; if either constant
+    # drifts, spb silently truncates and candidate segments misalign
+    assert block_rows % _SEG_BLOCKS == 0, (block_rows, _SEG_BLOCKS)
     grid = pl.cdiv(rows, block_rows)
     spb = block_rows // _SEG_BLOCKS
     ns = grid * spb
@@ -950,6 +954,187 @@ def fused_compensate_bits_cands(grad: jax.Array, mmt: jax.Array,
         interpret=_interpret(),
     )(g2, m2, v2, b2)
     return om.reshape(-1), ov.reshape(-1), cv, ci
+
+
+# ------------------------------------------------------------------ #
+# fused payload-apply epilogue                                       #
+# ------------------------------------------------------------------ #
+
+#: gathered-payload entries staged per grid page of the apply pass
+#: (4 KB per SMEM operand; one grid step applies one page)
+_APPLY_PAGE = 1024
+#: flat elements covered by one apply chunk — one VMEM-resident
+#: [_CHUNK_ROWS, 128] output block of the fused pass
+_APPLY_CHUNK = _CHUNK_ROWS * _LANE
+
+
+def payload_apply_bits_reference(values, indices, flags, total: int):
+    """jnp reference of :func:`payload_apply_bits`: the engine's historic
+    XLA epilogue — a zeros-operand scatter-add decompress of the gathered
+    payload plus the packed transmit-record scatter over the flagged
+    entries (the local worker's non-sentinel coordinates)."""
+    acc = jnp.zeros((total,), values.dtype).at[indices].add(values)
+    routed = jnp.where(flags, indices, total)
+    bits = pack_sent_bits(routed, total, sentinel=total)
+    return acc, bits
+
+
+def _payload_apply_kernel(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
+                          pf_ref, bits_donor_ref, acc_ref, bits_ref):
+    """One grid step applies one staged payload page into its chunk's
+    VMEM-resident output block. Pages of the same chunk are consecutive
+    (the staging sort guarantees it), so the output block revisits are
+    consecutive and the accumulation stays in VMEM between pages; the
+    first page of each chunk zero-initializes both blocks (every chunk
+    owns at least one page, so every block is fully defined)."""
+    del bits_donor_ref  # alias donor: never dereferenced
+    p = pl.program_id(0)
+
+    @pl.when(first_ref[p] == 1)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        bits_ref[...] = jnp.zeros_like(bits_ref)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1)
+
+    def body(j, carry):
+        off = po_ref[0, j]           # in-chunk offset, [0, _APPLY_CHUNK)
+        v = pv_ref[0, j]
+        f = pf_ref[0, j]
+        r = off // _LANE
+        c = off % _LANE
+        # value add: one dynamic-sublane row RMW; duplicates within a
+        # chunk serialize through the loop in sorted-index order
+        onehot = jnp.where(lane == c, v, jnp.zeros((), v.dtype))
+        cur = pl.load(acc_ref, (pl.ds(r, 1), slice(None)))
+        pl.store(acc_ref, (pl.ds(r, 1), slice(None)), cur + onehot)
+        # transmit bit (word layout of pack_sent_bits): word row
+        # off//4096, word lane off%128, bit (off//128)%32 — the chunk
+        # base contributes 0 to each (a multiple of 4096*32 rows)
+        wrow = off // (32 * _LANE)
+        bvec = jnp.where(lane == c, f << (r % 32), jnp.zeros((), jnp.int32))
+        bcur = pl.load(bits_ref, (pl.ds(wrow, 1), slice(None)))
+        pl.store(bits_ref, (pl.ds(wrow, 1), slice(None)), bcur | bvec)
+        return carry
+
+    jax.lax.fori_loop(0, cnt_ref[p], body, 0)
+
+
+def payload_apply_bits(values, indices, flags, total: int,
+                       bits_donor=None):
+    """Fused apply epilogue: decompress scatter-add + transmit-record
+    pack in ONE streamed pass over the flat [total] buffer.
+
+    ``values``/``indices``/``flags`` are the flattened gathered payload
+    ([W * payload]; values already worker-averaged): ``acc[idx] += v``
+    for every entry, and the packed transmit bit set for entries with
+    ``flags`` nonzero (the engine flags the LOCAL worker's non-sentinel
+    entries, reproducing :func:`pack_sent_bits` on the local indices).
+
+    The payload is pre-bucketed at payload scale (one sort + cumsum +
+    one payload-sized staging scatter): entries sort by 2048-row chunk
+    and stage into whole :data:`_APPLY_PAGE`-entry pages per chunk, so a
+    single grid pass over the pages can map each page to its chunk's
+    [_CHUNK_ROWS, 128] output block via scalar-prefetched page->chunk
+    indices. Unlike the XLA path's four separate [T]-scale streams
+    (zeros init, value scatter, bit scatter, and the next consumer's
+    re-read), the flat buffer is written exactly once, chunk by chunk,
+    while the chunk is VMEM-resident. ``bits_donor`` (the PREVIOUS
+    step's dead ``sent_bits`` buffer) is donated via
+    ``input_output_aliases`` so the record is rebuilt in place — no
+    fresh [total/32] allocation; the kernel never reads it (every block
+    zero-initializes on its first page).
+
+    Numerics: bitwise :func:`payload_apply_bits_reference` for unique
+    real indices (any scatter order agrees); with cross-worker duplicate
+    coordinates the add order is sorted-index (stable) rather than XLA's
+    unspecified scatter order — equal to f32 rounding. f32 values only
+    (the engine gates). Returns ``(acc [total], bits
+    [num_sent_words(total)])``."""
+    n = values.shape[0]
+    assert total % _LANE == 0, total
+    assert indices.shape == (n,) and flags.shape == (n,)
+    assert values.dtype == jnp.float32, values.dtype
+    nchunks = -(-total // _APPLY_CHUNK)
+    pg = _APPLY_PAGE
+    npages_data = -(-n // pg)
+    npages = npages_data + nchunks          # static capacity bound
+    brows = num_sent_words(total) // _LANE
+
+    # ---- payload-scale pre-bucketing (plain XLA) ----
+    order = jnp.argsort(indices)
+    si = jnp.take(indices, order)
+    sv = jnp.take(values, order)
+    sf = jnp.take(flags, order).astype(jnp.int32)
+    ch = (si // _APPLY_CHUNK).astype(jnp.int32)
+    off = (si - ch.astype(si.dtype) * _APPLY_CHUNK).astype(jnp.int32)
+    starts = jnp.searchsorted(
+        ch, jnp.arange(nchunks, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)                                     # [nchunks]
+    counts = jnp.diff(jnp.concatenate(
+        [starts, jnp.full((1,), n, jnp.int32)]))
+    # every chunk owns >= 1 page (possibly empty) so every output block
+    # is visited and zero-initialized — correctness does not depend on
+    # the donor's contents
+    pages_per = jnp.maximum(-(-counts // pg), 1)
+    page_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pages_per)])  # pages
+    pos = page_start[ch] * pg + (jnp.arange(n, dtype=jnp.int32)
+                                 - starts[ch])
+    cap = npages * pg
+    stage_v = jnp.zeros((cap,), values.dtype).at[pos].set(sv)
+    stage_o = jnp.zeros((cap,), jnp.int32).at[pos].set(off)
+    stage_f = jnp.zeros((cap,), jnp.int32).at[pos].set(sf)
+    pageid = jnp.arange(npages, dtype=jnp.int32)
+    page_chunk = jnp.clip(
+        jnp.searchsorted(page_start, pageid, side="right").astype(
+            jnp.int32) - 1, 0, nchunks - 1)
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (page_chunk[1:] != page_chunk[:-1]).astype(jnp.int32)])
+    pcount = jnp.clip(
+        counts[page_chunk] - (pageid - page_start[page_chunk]) * pg,
+        0, pg)
+
+    if bits_donor is None:
+        bits_donor = jnp.zeros((brows, _LANE), jnp.int32)
+    else:
+        assert bits_donor.shape == (brows * _LANE,), bits_donor.shape
+        bits_donor = bits_donor.reshape(brows, _LANE)
+
+    pspec = lambda dt: pl.BlockSpec((1, pg), lambda p, pc, fr, ct: (p, 0),
+                                    memory_space=pltpu.SMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(npages,),
+        in_specs=[
+            pspec(values.dtype),
+            pspec(jnp.int32),
+            pspec(jnp.int32),
+            pl.BlockSpec(memory_space=pltpu.ANY),     # bits donor
+        ],
+        out_specs=(
+            pl.BlockSpec((_CHUNK_ROWS, _LANE),
+                         lambda p, pc, fr, ct: (pc[p], 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_CHUNK_ROWS // 32, _LANE),
+                         lambda p, pc, fr, ct: (pc[p], 0),
+                         memory_space=pltpu.VMEM),
+        ),
+    )
+    acc, bits = pl.pallas_call(
+        _payload_apply_kernel,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((total // _LANE, _LANE),
+                                        values.dtype),
+                   jax.ShapeDtypeStruct((brows, _LANE), jnp.int32)),
+        # the dead previous-step record is rebuilt in place
+        input_output_aliases={6: 1},
+        interpret=_interpret(),
+    )(page_chunk, first, pcount,
+      stage_v.reshape(npages, pg), stage_o.reshape(npages, pg),
+      stage_f.reshape(npages, pg), bits_donor)
+    return acc.reshape(-1), bits.reshape(-1)
 
 
 # ------------------------------------------------------------------ #

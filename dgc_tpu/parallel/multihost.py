@@ -15,6 +15,7 @@ Launchers in ``script/`` show the three standard entries: single host,
 (``sample_slurm.sh`` parity).
 """
 
+import inspect
 import os
 from typing import Optional
 
@@ -38,7 +39,9 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
 
     ``timeouts`` forwards ``initialization_timeout`` /
     ``heartbeat_timeout_seconds`` / ``shutdown_timeout_seconds`` to
-    ``jax.distributed.initialize``. The shutdown timeout matters on cold
+    ``jax.distributed.initialize`` (keywords this JAX doesn't accept are
+    dropped — the older releases hard-code those two timeouts server
+    side). The shutdown timeout matters on cold
     machines: processes reach the coordination service's shutdown barrier
     skewed by however much their compile times diverge, and the 300 s
     default is shorter than a cold multi-minute XLA compile — the barrier
@@ -68,11 +71,12 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
              or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
     if not multi:
         return False
+    accepted = inspect.signature(jax.distributed.initialize).parameters
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
-        **timeouts)
+        **{k: v for k, v in timeouts.items() if k in accepted})
     return True
 
 

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dgc_tpu.ops import kernels
 from dgc_tpu.optim.distributed import DistributedOptimizer
 from dgc_tpu.training.state import TrainState, state_specs, with_leading_axis
+from dgc_tpu.utils.compat import shard_map
 
 __all__ = ["build_train_step", "build_eval_step", "make_loss_fn",
            "FlatSetup", "make_flat_setup", "make_flat_state"]
@@ -283,7 +284,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step_fn(state, images, labels, key):
         specs = state_specs(state, axes, per_worker_opt)
-        sharded = jax.shard_map(
+        sharded = shard_map(
             worker, mesh=mesh,
             in_specs=(specs, P(axes), P(axes), P()),
             out_specs=(specs, {"loss": P()}),
@@ -327,7 +328,7 @@ def build_eval_step(apply_fn: Callable, mesh: Mesh, world_size: int,
     def eval_fn(params, batch_stats, images, labels):
         out_specs = {f"top{k}": P() for k in topk}
         out_specs["count"] = P()
-        sharded = jax.shard_map(
+        sharded = shard_map(
             worker, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P(), params),
                       jax.tree.map(lambda _: P(axis), batch_stats),

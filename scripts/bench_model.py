@@ -37,6 +37,13 @@ def main():
                     help="with --int8: disable quantization error "
                          "feedback (the round-3 no-feedback form) — "
                          "isolates the feedback path's step-time cost")
+    ap.add_argument("--fused-apply", action="store_true",
+                    help="fused apply epilogue (DGCCompressor "
+                         "fused_apply=True): decompress scatter-add + "
+                         "transmit-record pack as one streamed Pallas "
+                         "pass (kernels.payload_apply_bits). Run once "
+                         "with and once without to A/B paired against "
+                         "the identical dense arm.")
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 model compute (configs/bf16.py): both "
                          "arms build the model with dtype=bf16 and the "
@@ -108,7 +115,8 @@ def main():
 
     comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(
         momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8,
-        int8_error_feedback=not args.no_int8_ef)
+        int8_error_feedback=not args.no_int8_ef,
+        fused_apply=args.fused_apply)
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     dgc_run, setup = prepare(DistributedOptimizer(
         dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))

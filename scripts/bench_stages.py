@@ -27,6 +27,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax
 import jax.numpy as jnp
 import numpy as np
+from dgc_tpu.utils.compat import shard_map
 
 
 _ssum = jax.jit(lambda x: jnp.sum(x))
@@ -120,7 +121,7 @@ def main():
         def worker(fg, mm):
             out, mm = engine.exchange(fg, mm, key, "data", 1)
             return out, mm
-        out, m = jax.shard_map(
+        out, m = shard_map(
             worker, mesh=mesh, in_specs=(Pspec(), Pspec()),
             out_specs=(Pspec(), Pspec()), check_vma=False)(grad, m)
         return (out * 0.999, m)

@@ -19,6 +19,11 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# pre-0.5 JAX defaults CPU cross-process collectives to "none" ("Multiprocess
+# computations aren't implemented on the CPU backend"); newer releases
+# default to gloo already
+if "jax_cpu_collectives_implementation" in jax.config.values:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
+from dgc_tpu.utils.compat import shard_map
 
 from dgc_tpu import (
     Compression,
@@ -70,7 +71,7 @@ def test_adasum_distributed_optimizer_flat(mesh8):
         upd, _, _ = dist.update_flat(fg[0], opt_state, fp, {}, key, engine)
         return upd[None]
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         worker, mesh=mesh8, in_specs=(P("data"), P(), P()),
         out_specs=P("data"), check_vma=False))
     upd = f(jnp.broadcast_to(g[None], (W,) + g.shape), flat_p,
@@ -102,7 +103,7 @@ def test_adasum_per_tensor_dense_matches_reduce_oracle(mesh8):
                                     key, jax.lax.axis_index("data")))
         return jax.tree.map(lambda x: x[None], upd)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         worker, mesh=mesh8, in_specs=(P("data"), P(), P()),
         out_specs=P("data"), check_vma=False))
     upd = f(grads_w, params, jax.random.PRNGKey(0))
@@ -141,7 +142,7 @@ def test_adasum_per_tensor_with_dgc(mesh8):
         return (jax.tree.map(lambda x: x[None], upd),
                 jax.tree.map(lambda x: x[None], m))
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         worker, mesh=mesh8, in_specs=(P(), P("data"), P()),
         out_specs=(P("data"), P("data")), check_vma=False))
     mem_w = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
@@ -186,7 +187,7 @@ def test_adasum_with_dgc_compression(mesh8):
         upd, _, m = dist.update_flat(fg[0], opt_state, fp, m, key, engine)
         return upd[None], jax.tree.map(lambda x: x[None], m)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         worker, mesh=mesh8, in_specs=(P("data"), P(), P("data"), P()),
         out_specs=(P("data"), P("data")), check_vma=False))
     mem_w = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
@@ -210,7 +211,7 @@ def test_adasum_allreduce_matches_gathered_reduce(mesh8):
     def worker(x):
         return adasum_allreduce(x[0], "data", W)[None]
 
-    f = jax.jit(jax.shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
+    f = jax.jit(shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
                               out_specs=P("data"), check_vma=False))
     got = np.asarray(f(xs))
     want = np.asarray(adasum_reduce(xs))

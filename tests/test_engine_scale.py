@@ -13,6 +13,7 @@ from jax.sharding import PartitionSpec as P
 from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer, dgc_sgd
 from dgc_tpu.parallel import make_mesh
 from dgc_tpu.utils.pytree import named_flatten
+from dgc_tpu.utils.compat import shard_map
 
 
 def _build(model_fn, num_classes=1000, ratio=0.001, image_size=32):
@@ -34,9 +35,12 @@ def test_engine_builds_at_imagenet_scale(name):
     # VGG's classifier head needs the real 224 spatial extent
     comp, dist, layout, engine = _build(
         getattr(M, name), image_size=224 if name == "vgg16_bn" else 32)
-    # wire volume == reference's sum of per-tensor num_selects
-    assert engine.payload_size == sum(
-        a.num_selects for a in comp.attributes.values())
+    # wire volume within the padded-payload gate's documented bound: the
+    # round-5 identity-tight fast path (flat._PAD_PAYLOAD_MAX_FRAC) may
+    # inflate the payload by <= 2% over the reference's sum of per-tensor
+    # num_selects, never shrink it
+    ref_wire = sum(a.num_selects for a in comp.attributes.values())
+    assert ref_wire <= engine.payload_size <= 1.02 * ref_wire
     # every compressed tensor is in one bucket row, except giant tensors
     # (> _SPLIT_COLS) which split into segment rows with the SAME total
     # quota (stratified selection; wire volume asserted above)
@@ -92,7 +96,7 @@ def test_resnet50_exchange_one_step():
         out, m = engine.exchange(fg, m, key, "data", 1)
         return out, m
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         worker, mesh=mesh, in_specs=(P(), P(), P()), out_specs=(P(), P()),
         check_vma=False))
     out, mem = f(g, mem, jax.random.PRNGKey(0))

@@ -15,6 +15,7 @@ from dgc_tpu import (
     sgd,
 )
 from dgc_tpu.training import with_leading_axis
+from dgc_tpu.utils.compat import shard_map
 
 W = 8
 
@@ -28,7 +29,7 @@ def _exchange_fn(dist, mesh):
         return (jax.tree.map(lambda x: x[None], out),
                 jax.tree.map(lambda x: x[None], mem))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         worker, mesh=mesh,
         in_specs=(P("data"), P("data"), P()),
         out_specs=(P("data"), P("data")),
@@ -148,7 +149,7 @@ def test_global_clip_helpers(mesh8):
         out2 = clip_grad_value_by_global_norm(g, axis_name="data")
         return out1[None], out2[None]
 
-    f = jax.jit(jax.shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
+    f = jax.jit(shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
                               out_specs=(P("data"), P("data")),
                               check_vma=False))
     g = np.full((W, 4), 2.0, np.float32)

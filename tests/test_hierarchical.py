@@ -29,6 +29,7 @@ from dgc_tpu import (
 from dgc_tpu.parallel import make_mesh, make_two_tier_mesh
 from dgc_tpu.training import with_leading_axis
 from dgc_tpu.utils.pytree import named_flatten
+from dgc_tpu.utils.compat import shard_map
 
 H, L, W = 2, 4, 8
 
@@ -82,7 +83,7 @@ def _two_tier_fn(engine, mesh):
                                    local_axis="local", local_size=L)
         return out[None], jax.tree.map(lambda x: x[None], mem)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         worker, mesh=mesh, in_specs=(P(axes), P(axes), P()),
         out_specs=(P(axes), P(axes)), check_vma=False))
 
@@ -95,7 +96,7 @@ def _flat_fn(engine, mesh, world):
         out, mem = engine.exchange(fg, mem, key, "data", world)
         return out[None], jax.tree.map(lambda x: x[None], mem)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         worker, mesh=mesh, in_specs=(P("data"), P("data"), P()),
         out_specs=(P("data"), P("data")), check_vma=False))
 
@@ -176,7 +177,7 @@ def test_two_tier_dense_tail_and_sum_op(mesh2x4):
                                    local_axis="local", local_size=L)
         return out[None], jax.tree.map(lambda x: x[None], mem)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         worker, mesh=mesh2x4,
         in_specs=(P(("hosts", "local")), P(("hosts", "local")), P()),
         out_specs=(P(("hosts", "local")), P(("hosts", "local"))),
@@ -207,7 +208,7 @@ def test_two_tier_per_tensor_path_matches_flat_engine(mesh2x4):
                 jax.tree.map(lambda x: x[None], mem))
 
     axes = ("hosts", "local")
-    pt = jax.jit(jax.shard_map(
+    pt = jax.jit(shard_map(
         pt_worker, mesh=mesh2x4, in_specs=(P(axes), P(axes), P()),
         out_specs=(P(axes), P(axes)), check_vma=False))
     two_tier = _two_tier_fn(engine, mesh2x4)
@@ -325,7 +326,7 @@ def test_two_tier_dense_fp16_wire_divides_before_cast(mesh2x4):
         return out[None]
 
     axes = ("hosts", "local")
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         worker, mesh=mesh2x4, in_specs=(P(axes), P()),
         out_specs=P(axes), check_vma=False))
     out = np.asarray(f(jnp.asarray(g), jax.random.PRNGKey(0)))
@@ -378,7 +379,7 @@ def test_two_tier_adasum_matches_flat_oracle(mesh2x4):
                                    local_axis="local", local_size=L)
         return out[None], jax.tree.map(lambda x: x[None], mem)
 
-    two_tier = jax.jit(jax.shard_map(
+    two_tier = jax.jit(shard_map(
         tt_worker, mesh=mesh2x4, in_specs=(P(axes), P(axes), P()),
         out_specs=(P(axes), P(axes)), check_vma=False))
 
@@ -389,7 +390,7 @@ def test_two_tier_adasum_matches_flat_oracle(mesh2x4):
         out, mem = engine.exchange(fg, mem, key, "data", H, op="adasum")
         return out[None], jax.tree.map(lambda x: x[None], mem)
 
-    flat = jax.jit(jax.shard_map(
+    flat = jax.jit(shard_map(
         flat_worker, mesh=mesh2, in_specs=(P("data"), P("data"), P()),
         out_specs=(P("data"), P("data")), check_vma=False))
 
@@ -490,7 +491,7 @@ def test_two_tier_adasum_per_tensor_update_matches_flat(mesh2x4):
                 jax.tree.map(lambda x: x[None], mem))
 
     axes = ("hosts", "local")
-    tt = jax.jit(jax.shard_map(
+    tt = jax.jit(shard_map(
         tt_worker, mesh=mesh2x4,
         in_specs=({n: P(axes) for n in named}, P(axes), P()),
         out_specs=({n: P(axes) for n in named}, P(axes)),
@@ -507,7 +508,7 @@ def test_two_tier_adasum_per_tensor_update_matches_flat(mesh2x4):
                 jax.tree.map(lambda x: x[None], mem))
 
     mesh2 = make_mesh(H)
-    fl = jax.jit(jax.shard_map(
+    fl = jax.jit(shard_map(
         flat_worker, mesh=mesh2,
         in_specs=({n: P("data") for n in named}, P("data"), P()),
         out_specs=({n: P("data") for n in named}, P("data")),

@@ -517,3 +517,87 @@ def test_opaque_view_from_matches_slice():
     # misalignment and overrun are rejected
     assert not kernels.opaque_view_eligible(total, 128, 1024)
     assert not kernels.opaque_view_eligible(total, total - 1024, 2048)
+
+
+@pytest.mark.parametrize("total", [
+    45 * 4096 + 2048,                       # single ragged chunk
+    2 * 2048 * 128 + 37 * 4096 + 2048,      # multi-chunk, ragged tail
+])
+def test_payload_apply_bits_matches_reference(total):
+    """The fused apply epilogue vs the jnp reference (the engine's XLA
+    scatter pair): with unique real indices any scatter order agrees, so
+    acc is BITWISE and the transmit record exact — including empty
+    chunks, a stale (garbage) donated record buffer, and sentinel-style
+    zero-value pad entries."""
+    from dgc_tpu.ops import kernels
+
+    rng = np.random.RandomState(11)
+    n = 4000
+    idx = jnp.asarray(rng.choice(total, n, replace=False).astype(np.int32))
+    vals = jnp.asarray(rng.randn(n).astype(np.float32))
+    flags = jnp.asarray((rng.rand(n) < 0.4).astype(np.int32))
+    acc_r, bits_r = jax.jit(
+        lambda v, i, f: kernels.payload_apply_bits_reference(
+            v, i, f, total))(vals, idx, flags)
+    acc_k, bits_k = jax.jit(
+        lambda v, i, f: kernels.payload_apply_bits(
+            v, i, f, total))(vals, idx, flags)
+    np.testing.assert_array_equal(np.asarray(acc_k), np.asarray(acc_r))
+    np.testing.assert_array_equal(np.asarray(bits_k), np.asarray(bits_r))
+
+    # the donated previous-step record must never leak: fill it with
+    # garbage and require the identical fresh record
+    donor = jnp.asarray(rng.randint(
+        -2**31, 2**31 - 1, size=kernels.num_sent_words(total),
+        dtype=np.int64).astype(np.int32))
+    acc_d, bits_d = jax.jit(
+        lambda v, i, f, d: kernels.payload_apply_bits(
+            v, i, f, total, bits_donor=d))(vals, idx, flags, donor)
+    np.testing.assert_array_equal(np.asarray(acc_d), np.asarray(acc_r))
+    np.testing.assert_array_equal(np.asarray(bits_d), np.asarray(bits_r))
+
+    # sentinel-style pads: repeated index, zero value, flag 0 — no-ops
+    sent = total - 1
+    idx2 = jnp.concatenate([idx, jnp.full((300,), sent, jnp.int32)])
+    v2 = jnp.concatenate([vals, jnp.zeros((300,), jnp.float32)])
+    f2 = jnp.concatenate([flags, jnp.zeros((300,), jnp.int32)])
+    acc_s, bits_s = jax.jit(
+        lambda v, i, f: kernels.payload_apply_bits(
+            v, i, f, total))(v2, idx2, f2)
+    np.testing.assert_array_equal(np.asarray(acc_s), np.asarray(acc_r))
+    np.testing.assert_array_equal(np.asarray(bits_s), np.asarray(bits_r))
+
+
+def test_payload_apply_bits_duplicates_and_empty_chunks():
+    """Cross-worker duplicate coordinates: the staged adds run in
+    stable sorted order (payload order within a coordinate), summing the
+    same contribution sets as the reference scatter — within one f32
+    rounding; the record (an OR over unique local coordinates) stays
+    exact. A chunk with no payload at all must come back all-zero."""
+    from dgc_tpu.ops import kernels
+
+    rng = np.random.RandomState(13)
+    total = 3 * 2048 * 128
+    base = rng.choice(4096, 500, replace=False)
+    # worker-style duplication: same coordinates contributed 3x, plus a
+    # block landing only in the LAST chunk, leaving the middle one empty
+    idx = np.concatenate([base, base, base,
+                          2 * 2048 * 128 + rng.choice(4096, 200,
+                                                      replace=False)])
+    vals = rng.randn(idx.size).astype(np.float32)
+    flags = np.zeros(idx.size, np.int32)
+    flags[:500] = 1
+    idx, vals, flags = (jnp.asarray(idx.astype(np.int32)),
+                        jnp.asarray(vals), jnp.asarray(flags))
+    acc_r, bits_r = jax.jit(
+        lambda v, i, f: kernels.payload_apply_bits_reference(
+            v, i, f, total))(vals, idx, flags)
+    acc_k, bits_k = jax.jit(
+        lambda v, i, f: kernels.payload_apply_bits(
+            v, i, f, total))(vals, idx, flags)
+    np.testing.assert_allclose(np.asarray(acc_k), np.asarray(acc_r),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(np.asarray(bits_k), np.asarray(bits_r))
+    # empty middle chunk: all-zero despite never receiving an entry
+    mid = np.asarray(acc_k[2048 * 128:2 * 2048 * 128])
+    assert not mid.any()

@@ -108,6 +108,57 @@ def test_meters():
     assert m2.compute() == 100.0
 
 
+@pytest.mark.parametrize("k", [1, 2, 5])
+def test_meter_ties_vs_device_topk(k):
+    """Tie semantics of the host meter (np.argpartition) vs the on-device
+    eval count (jax.lax.top_k membership, build_eval_step). Neither order
+    within a tied group is specified, so the contract is set membership:
+
+    - target strictly inside the top k (fewer than k scores >= its own,
+      counting itself last): BOTH must count it correct;
+    - k scores strictly above the target: BOTH must count it wrong;
+    - ties straddling the k-th boundary that include the target: each
+      implementation may pick either side — only bounded, not pinned.
+
+    Both counts must land inside the per-row [guaranteed, possible] band;
+    on unambiguous rows they must agree exactly."""
+    from dgc_tpu.utils.meters import TopKClassMeter
+
+    rng = np.random.RandomState(7 + k)
+    N, C = 256, 10
+    # tie-heavy scores: small integer support so boundary ties are common
+    outputs = rng.randint(0, 4, size=(N, C)).astype(np.float32)
+    targets = rng.randint(0, C, size=(N,)).astype(np.int32)
+
+    m = TopKClassMeter(k=k)
+    m.update(outputs, targets)
+    host = m.num_correct
+
+    # the device-side count, exactly as build_eval_step computes it
+    _, pred = jax.lax.top_k(jnp.asarray(outputs), min(k, C))
+    dev = int(jnp.sum(jnp.any(
+        pred == jnp.asarray(targets)[:, None], axis=-1)))
+
+    ts = outputs[np.arange(N), targets]
+    above = (outputs > ts[:, None]).sum(axis=-1)
+    at_or_above = (outputs >= ts[:, None]).sum(axis=-1)  # includes target
+    must = at_or_above <= k        # any valid top-k set contains the target
+    cant = above >= k              # no valid top-k set contains the target
+    ambiguous = ~must & ~cant
+    lo, hi = int(must.sum()), int((~cant).sum())
+    assert lo <= host <= hi, (host, lo, hi)
+    assert lo <= dev <= hi, (dev, lo, hi)
+
+    # unambiguous rows: per-row agreement, not just aggregate
+    sub = ~ambiguous
+    mu = TopKClassMeter(k=k)
+    mu.update(outputs[sub], targets[sub])
+    _, pu = jax.lax.top_k(jnp.asarray(outputs[sub]), min(k, C))
+    du = int(jnp.sum(jnp.any(
+        pu == jnp.asarray(targets[sub])[:, None], axis=-1)))
+    assert mu.num_correct == du == int(must[sub].sum())
+
+
 @pytest.mark.parametrize("ctor,shape", [
     (resnet20, (32, 32)), (resnet18, (56, 56)), (vgg16_bn, (224, 224))])
 def test_bf16_compute_keeps_f32_params_and_logits(ctor, shape):
