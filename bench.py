@@ -90,7 +90,7 @@ def _measure_rtt(samples: int = 8) -> float:
     return best
 
 
-def _make_k_loop(step_fn, images, labels, k):
+def _make_k_loop(step_fn, images, labels, k, consume_metrics=False):
     """K train steps inside ONE jitted lax.scan: a single dispatch drives K
     device iterations, so the relay's per-call dispatch latency (which in
     slow phases exceeds the step's device time) cannot contaminate the
@@ -98,13 +98,23 @@ def _make_k_loop(step_fn, images, labels, k):
     scan inserts per-iteration carry copies (measured ~1 ms/step of
     'data formatting'/dynamic-update-slice ops attributed to this line in
     the device profile) that per-dispatch training with donation never
-    pays, inflating the DGC side (bigger carry) more than the dense side."""
+    pays, inflating the DGC side (bigger carry) more than the dense side.
+
+    ``consume_metrics``: sum EVERY metric leaf into a live scalar output
+    (not just the loss) so XLA cannot dead-code-eliminate aux outputs —
+    required for an honest telemetry A/B (the telemetry stats must be
+    computed, exactly as a training loop feeding a sink computes them).
+    The default keeps the historical loop byte-identical."""
     import functools
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def k_loop(state, key):
         def body(s, ki):
             s2, m = step_fn(s, images, labels, ki)
+            if consume_metrics:
+                acc = sum(jnp.sum(l.astype(jnp.float32))
+                          for l in jax.tree.leaves(m))
+                return s2, acc
             return s2, m["loss"]
         s, losses = jax.lax.scan(body, state, jax.random.split(key, k))
         return s, losses[-1]
@@ -203,13 +213,14 @@ def main():
                    train=True)
     named, _ = named_flatten(v["params"])
 
-    def prepare(dist):
+    def prepare(dist, telemetry=False, consume=False):
         setup = make_flat_setup(v, dist)
         state = shard_state(make_flat_state(v, dist, setup, W), mesh,
                             dist_opt=dist)
         step = build_train_step(model.apply, dist, mesh, donate=False,
-                                flat=setup)
-        return (_make_k_loop(step, images, labels, K_STEPS), state), setup
+                                flat=setup, telemetry=telemetry)
+        return (_make_k_loop(step, images, labels, K_STEPS,
+                             consume_metrics=consume), state), setup
 
     # --- DGC at the north-star 0.1% ratio (flat fused engine) vs the
     #     dense baseline with the identical step shape, interleaved ---
@@ -222,6 +233,38 @@ def main():
     comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
                          fused_apply=fused_apply)
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+
+    if os.environ.get("DGC_TELEMETRY_AB", "") == "1":
+        # telemetry-overhead A/B: the pair is dgc+telemetry vs dgc, SAME
+        # paired interleaved methodology as the headline run. Both arms
+        # use the metric-consuming loop so the comparison is symmetric
+        # and the telemetry aux outputs cannot be dead-code-eliminated.
+        # Acceptance gate (ISSUE 2): median overhead <= 1% of step time.
+        def mk_dist():
+            return DistributedOptimizer(
+                dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp,
+                world_size=W)
+        tel_run, _ = prepare(mk_dist(), telemetry=True, consume=True)
+        off_run, _ = prepare(mk_dist(), telemetry=False, consume=True)
+        rows = _interleaved_step_ms([tel_run, off_run], rtt)
+        tel_ms, off_ms = (min(col) for col in zip(*rows))
+        diffs = [a - b for a, b in rows]
+        overhead = statistics.median(diffs)
+        q1, q3 = (float(x) for x in np.percentile(diffs, [25, 75]))
+        print(f"telemetry step {tel_ms:.4f} ms | plain step {off_ms:.4f} "
+              f"ms | paired median overhead {overhead:.4f} ms "
+              f"({100 * overhead / max(off_ms, 1e-9):.2f}%)",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": "telemetry_overhead_ms_resnet20_dgc0.001",
+            "value": round(overhead, 4),
+            "unit": "ms/step",
+            "step_ms": round(off_ms, 4),
+            "overhead_frac": round(overhead / max(off_ms, 1e-9), 4),
+            "overhead_rounds_ms": [round(d, 4) for d in diffs],
+        }))
+        return
+
     dgc_run, dgc_setup = prepare(DistributedOptimizer(
         dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))
     dense_run, _ = prepare(DistributedOptimizer(
@@ -313,6 +356,28 @@ def main():
 
     dense_exchange, dgc_exchange = rows["32x25GbE"]
     ici_dense, ici_dgc = rows["v5e8_ICI"]
+
+    # DGC_TELEMETRY_OUT=path: also record this run through the telemetry
+    # sink (schema-versioned JSONL with a run_summary record) so the
+    # regression gate can compare it against a BENCH_r*.json baseline:
+    #   python -m dgc_tpu.telemetry.regress BENCH_r05.json path --tol 0.10
+    telem_out = os.environ.get("DGC_TELEMETRY_OUT", "")
+    if telem_out:
+        from dgc_tpu.telemetry.sink import TelemetrySink
+        with TelemetrySink(telem_out,
+                           static=dgc_setup.engine.telemetry_static()) as sk:
+            sk.write_record({
+                "event": "run_summary",
+                "step_time_ms": round(dgc_ms, 4),
+                "dense_step_ms": round(dense_ms, 4),
+                "overhead_ms": round(dgc_overhead_ms, 4),
+                "exchange_ms": round(dgc_exchange, 4),
+                "wire_bytes": dgc_setup.engine.wire_bytes_per_worker(),
+                "payload_elems": payload,
+                "vs_baseline": round(dense_exchange / dgc_exchange, 2),
+            })
+        print(f"telemetry run written: {telem_out}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "grad_exchange_ms_resnet20_dgc0.001_32x25GbE",
         "value": round(dgc_exchange, 4),

@@ -1,0 +1,21 @@
+"""Telemetry knob (docs/TELEMETRY.md): append to any config stack to turn
+the in-graph compression-health taps + async JSONL sink on:
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/telemetry.py [--train.telemetry.every 10]
+
+Stats ride the jitted step's aux outputs (zero extra host syncs or
+dispatches); the sink writes coordinator-only JSONL under
+<save_path>/telemetry/. Gate a run against a recorded baseline with
+``python -m dgc_tpu.telemetry.regress``.
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.telemetry = Config()
+configs.train.telemetry.enabled = True
+# log every Nth step (1 = every step; the stats are device scalars either
+# way — `every` only thins the JSONL volume)
+configs.train.telemetry.every = 1
+# rotate the JSONL file once it exceeds this many MiB
+configs.train.telemetry.rotate_mb = 64

@@ -252,6 +252,24 @@ class DGCCompressor(Compressor):
         from dgc_tpu.compression.flat import FlatDGCEngine
         return FlatDGCEngine(self, layout)
 
+    def telemetry_attributes(self) -> Dict[str, Dict[str, float]]:
+        """Static per-tensor selection geometry for telemetry headers
+        (``dgc_tpu.telemetry``): the configured transmit budget every
+        tensor is held to. The in-graph taps report the *realized*
+        per-bucket selected fraction each step; readers compare it against
+        ``expected_frac`` here to see whether the sampled threshold is
+        over- or under-selecting."""
+        return {
+            name: {
+                "numel": a.numel,
+                "num_selects": a.num_selects,
+                "num_samples": a.num_samples,
+                "sample_stride": a.sample_stride,
+                "expected_frac": round(a.num_selects / a.numel, 8),
+            }
+            for name, a in self.attributes.items()
+        }
+
     # ------------------------------------------------------------------ #
     # traced (pure) pieces                                               #
     # ------------------------------------------------------------------ #

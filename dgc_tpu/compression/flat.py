@@ -614,6 +614,54 @@ class FlatDGCEngine:
         self._seg_fused = any(self._use_seg_kernel(b) for b in self.buckets)
 
     # -------------------------------------------------------------- #
+    # telemetry geometry (dgc_tpu.telemetry)                         #
+    # -------------------------------------------------------------- #
+
+    def wire_bytes_per_worker(self) -> int:
+        """Static per-worker sparse wire bytes per step: the values
+        all_gather payload (int8/fp16/full precision, plus the per-row f32
+        scale wire under int8) + the index all_gather payload (packed
+        bitstream or flat offsets). The dense-fallback psum is NOT counted
+        here — it is the same on both arms of every comparison."""
+        if not self.payload_size:
+            return 0
+        if self._row_map is not None:
+            val_bytes = self.payload_size + 4 * self.payload_rows
+        elif self.c.fp16_values:
+            val_bytes = 2 * self.payload_size
+        else:
+            val_bytes = self.payload_size * np.dtype(self.layout.dtype).itemsize
+        if self._codec is not None:
+            idx_bytes = 4 * self._codec.nwords
+        else:
+            idx_bytes = self.payload_size * jnp.dtype(self.index_dtype).itemsize
+        return int(val_bytes + idx_bytes)
+
+    def bucket_descriptors(self):
+        """Static per-bucket geometry for telemetry headers/readers: the
+        per-bucket stat columns (selected_frac, threshold) are emitted in
+        this order."""
+        return [{"base": int(b.base), "rows": int(b.rows),
+                 "cols": int(b.cols), "numel": int(np.sum(b.numels)),
+                 "num_selects": int(np.sum(b.num_selects)),
+                 "payload": int(b.payload)} for b in self.buckets]
+
+    def telemetry_static(self) -> Dict:
+        """Header block for the telemetry sink (see registry.make_header)."""
+        return {
+            "engine": type(self).__name__,
+            "num_params": int(self.layout.total),
+            "t_compressed": int(self.T),
+            "compress_ratio": float(self.c.compress_ratio),
+            "payload_elems": int(self.payload_size),
+            "wire_bytes": self.wire_bytes_per_worker(),
+            "index_bits": (round(self._codec.bits_per_index, 2)
+                           if self._codec is not None else
+                           8 * jnp.dtype(self.index_dtype).itemsize),
+            "buckets": self.bucket_descriptors(),
+        }
+
+    # -------------------------------------------------------------- #
     # memory (fused over the flat buffers)                           #
     # -------------------------------------------------------------- #
 
@@ -1205,13 +1253,19 @@ class FlatDGCEngine:
             vals = jnp.where(valid, sel_vals, jnp.zeros((), vec_c.dtype))
         return vals, gidx
 
-    def sparsify(self, vec_c: jax.Array, key: jax.Array, seg_cands=None):
+    def sparsify(self, vec_c: jax.Array, key: jax.Array, seg_cands=None,
+                 stats_out: Optional[Dict] = None):
         """Sampled-top-k selection over the compressed block [T].
 
         ``seg_cands`` — optional ``(cand_vals, cand_blks)`` from the
         fused compensate pass (kernels.fused_compensate_bits_cands);
         seg-kernel buckets then slice their segments instead of
         re-reading the flat buffer.
+
+        ``stats_out`` — optional dict the telemetry taps fill with
+        per-bucket selection stats (selected_frac, threshold,
+        payload_elems; see dgc_tpu.telemetry.registry) computed from the
+        emitted payload. Only traced when telemetry is on.
 
         Returns tight ``(values, indices)`` of length ``payload_size``;
         padded/invalid slots carry (0.0, sentinel) — the sentinel is the
@@ -1227,6 +1281,9 @@ class FlatDGCEngine:
         lay = self.layout
         S = lay.sentinel
         if not self.buckets:
+            if stats_out is not None:
+                from dgc_tpu.telemetry import taps
+                stats_out.update(taps.empty_bucket_stats(0))
             return (jnp.zeros((0,), vec_c.dtype),
                     jnp.zeros((0,), self.index_dtype))
         out_v, out_i = [], []
@@ -1356,6 +1413,20 @@ class FlatDGCEngine:
                              jnp.zeros((), vec_c.dtype))
 
             emit(vals, gidx, b)
+        if stats_out is not None:
+            # telemetry tap over the emitted payload (no extra HBM pass —
+            # the payload-sized arrays are already live): per-bucket real
+            # selection count / effective threshold, whole-model payload
+            from dgc_tpu.telemetry import taps
+            counts, thrs, fracs = [], [], []
+            for b, v, i in zip(self.buckets, out_v, out_i):
+                c, t = taps.bucket_payload_stats(v, i, S)
+                counts.append(c)
+                thrs.append(t)
+                fracs.append(c / float(np.sum(b.numels)))
+            stats_out["selected_frac"] = jnp.stack(fracs)
+            stats_out["threshold"] = jnp.stack(thrs)
+            stats_out["payload_elems"] = sum(counts)
         return jnp.concatenate(out_v), jnp.concatenate(out_i)
 
     # -------------------------------------------------------------- #
@@ -1377,9 +1448,17 @@ class FlatDGCEngine:
 
     def exchange(self, flat_grad: jax.Array, mem: Dict, key: jax.Array,
                  axis_name: str, world_size: int, op: str = "average",
-                 local_axis: Optional[str] = None, local_size: int = 1):
+                 local_axis: Optional[str] = None, local_size: int = 1,
+                 telemetry: bool = False):
         """compress -> communicate -> decompress over the whole model:
         two ``all_gather`` + one ``psum`` per step, total.
+
+        ``telemetry=True`` additionally returns a third element: the
+        per-step stat pytree of ``dgc_tpu.telemetry.registry.STEP_METRICS``
+        (device scalars computed from intermediates the exchange already
+        materializes — no host syncs, no extra dispatches). The default
+        ``False`` traces none of it, so the compiled program is byte-for-
+        byte the pre-telemetry HLO.
 
         ``op`` selects the combine semantics: "average" (hvd.Average — the
         harness default), "sum", or "adasum" (delta-optimizer variant, C5).
@@ -1419,6 +1498,10 @@ class FlatDGCEngine:
         T, P = self.T, self.layout.total
         m = self._mem
         clip = m.gradient_clipping if m is not None else None
+        if telemetry:
+            from dgc_tpu.telemetry import taps
+            grad_norm = taps.l2(flat_grad)
+            clip_delta = jnp.zeros((), jnp.float32)
 
         # ratio >= 1.0 (or nothing initialized): everything dense, with the
         # per-tensor path's non-accumulating correction (dgc.py compress
@@ -1426,9 +1509,17 @@ class FlatDGCEngine:
         if T == 0 or self.c.compress_ratio >= 1.0:
             avg = self._dense_combine(flat_grad, axis_name, world_size, op)
             if m is None:
+                if telemetry:
+                    return avg, mem, self._telemetry_stats(
+                        taps, grad_norm, clip_delta, None, None, None, None)
                 return avg, mem
             if clip is not None:
+                if telemetry:
+                    pre = taps.l2(avg)
                 avg = self._clip_block(avg, self.layout.names, 0)
+                if telemetry:
+                    clip_delta = ((pre - taps.l2(avg))
+                                  / jnp.maximum(pre, 1e-12))
             # materialize any pending transmit mask from a previous
             # compressed step before the non-accumulating correction (the
             # reference zeroed those coords at the compressed step,
@@ -1445,12 +1536,16 @@ class FlatDGCEngine:
             out_d, md2 = self._compensate_dense(mem["momentums_d"], avg[T:])
             out = (jnp.concatenate([out_c, out_d]) if T and P > T
                    else (out_c if T else out_d))
-            return out, {"momentums_c": mc2, "momentums_d": md2,
-                         "velocities_c": vc,
-                         "velocities_d": mem["velocities_d"],
-                         "sent_bits": jnp.zeros(
-                             (kernels.num_sent_words(T) if T else 0,),
-                             jnp.int32)}
+            new_mem = {"momentums_c": mc2, "momentums_d": md2,
+                       "velocities_c": vc,
+                       "velocities_d": mem["velocities_d"],
+                       "sent_bits": jnp.zeros(
+                           (kernels.num_sent_words(T) if T else 0,),
+                           jnp.int32)}
+            if telemetry:
+                return out, new_mem, self._telemetry_stats(
+                    taps, grad_norm, clip_delta, mc2, md2, vc, None)
+            return out, new_mem
 
         gc, gd = flat_grad[:T], flat_grad[T:]
         if m is not None:
@@ -1465,7 +1560,12 @@ class FlatDGCEngine:
             if clip is not None:
                 # clipping runs on the LOCAL gradient inside the accumulating
                 # compensate (reference memory.py:52-53)
+                if telemetry:
+                    pre = taps.l2(gc)
                 gc = self._clip_block(gc, self.layout.compressed_names, 0)
+                if telemetry:
+                    clip_delta = ((pre - taps.l2(gc))
+                                  / jnp.maximum(pre, 1e-12))
                 gsrc = gc
             else:
                 # the WHOLE flat buffer: on the fused-candidates TPU path
@@ -1484,7 +1584,9 @@ class FlatDGCEngine:
                 want_cands=self._seg_fused)
         else:
             comp = gc
-        values, indices = self.sparsify(comp, key, seg_cands=cands)
+        sel_stats: Optional[Dict] = {} if telemetry else None
+        values, indices = self.sparsify(comp, key, seg_cands=cands,
+                                        stats_out=sel_stats)
 
         dt = flat_grad.dtype
         int8_ef = False
@@ -1607,7 +1709,45 @@ class FlatDGCEngine:
             mem = {"momentums_c": mc, "velocities_c": vc,
                    "momentums_d": md, "velocities_d": mem["velocities_d"],
                    "sent_bits": new_bits}
+        if telemetry:
+            # transmitted energy from the live payload (invalid slots carry
+            # 0.0): under deferred masking vc still holds the transmitted
+            # values, so the untransmitted residual is ||vc||² minus it;
+            # under int8 error feedback vc was already rewritten to the
+            # residual above and is the norm directly.
+            tx_energy = (None if (m is None or int8_ef)
+                         else jnp.sum(values.astype(jnp.float32) ** 2))
+            return out, mem, self._telemetry_stats(
+                taps, grad_norm, clip_delta, mc, md, vc, sel_stats,
+                tx_energy=tx_energy)
         return out, mem
+
+    def _telemetry_stats(self, taps, grad_norm, clip_delta, mc, md, vc,
+                         sel, tx_energy=None):
+        """Assemble the STEP_METRICS pytree (see telemetry.taps). ``sel``
+        is sparsify's stats_out dict, or None on the dense-only paths
+        (zero payload, zero wire). ``tx_energy`` — sum of squared
+        transmitted values for the deferred-masking residual identity;
+        None means vc already IS the residual (dense path / int8 EF)."""
+        if sel is None:
+            sel = taps.empty_bucket_stats(len(self.buckets))
+            wire = 0.0
+        else:
+            wire = float(self.wire_bytes_per_worker())
+        if mc is None and md is None and vc is None:
+            mom = res = jnp.zeros((), jnp.float32)
+        else:
+            mom = jnp.sqrt(taps.l2(mc) ** 2 + taps.l2(md) ** 2)
+            if tx_energy is None:
+                res = taps.l2(vc)
+            else:
+                res = jnp.sqrt(jnp.maximum(
+                    jnp.sum(vc.astype(jnp.float32) ** 2) - tx_energy, 0.0))
+        return taps.assemble_step_stats(
+            grad_norm=grad_norm, momentum_norm=mom, residual_norm=res,
+            clip_delta=clip_delta, payload_elems=sel["payload_elems"],
+            wire_bytes=jnp.asarray(wire, jnp.float32),
+            selected_frac=sel["selected_frac"], threshold=sel["threshold"])
 
     # -------------------------------------------------------------- #
     # checkpoint-format parity (reference memory.py:79-88)           #
@@ -1686,14 +1826,27 @@ class FlatDenseExchange:
 
     def exchange(self, flat_grad, mem, key, axis_name, world_size,
                  op: str = "average", local_axis: Optional[str] = None,
-                 local_size: int = 1):
+                 local_size: int = 1, telemetry: bool = False):
+        if telemetry:
+            # dense-baseline taps: grad norm only; no sparse payload, no
+            # error-feedback state (wire_bytes is the SPARSE wire metric
+            # and stays 0 here — the dense psum is the baseline itself)
+            from dgc_tpu.telemetry import taps
+            stats = taps.assemble_step_stats(
+                grad_norm=taps.l2(flat_grad),
+                momentum_norm=jnp.zeros((), jnp.float32),
+                residual_norm=jnp.zeros((), jnp.float32),
+                clip_delta=jnp.zeros((), jnp.float32),
+                wire_bytes=jnp.zeros((), jnp.float32),
+                **taps.empty_bucket_stats(0))
         if op == "adasum":
             if local_axis is not None and local_size > 1:
                 # node-aggregated Adasum: the node mean is the participant
                 flat_grad = jax.lax.psum(flat_grad, local_axis) / local_size
             # full precision: fp16 dot/norm accumulations would overflow
             from dgc_tpu.optim.adasum import adasum_allreduce
-            return adasum_allreduce(flat_grad, axis_name, world_size), mem
+            out = adasum_allreduce(flat_grad, axis_name, world_size)
+            return (out, mem, stats) if telemetry else (out, mem)
         hier = local_axis is not None and local_size > 1
         if hier:
             # full-precision ICI tier first; the (optional fp16) wire cast
@@ -1708,7 +1861,7 @@ class FlatDenseExchange:
                                flat_grad.dtype)
         out = (total / world_size if op == "average" else total).astype(
             flat_grad.dtype)
-        return out, mem
+        return (out, mem, stats) if telemetry else (out, mem)
 
     def memory_state_dict(self, mem):
         return None

@@ -121,14 +121,26 @@ class DistributedOptimizer:
         return layout, engine
 
     def update_flat(self, flat_grads, opt_state, flat_params, mem_state,
-                    key, engine):
+                    key, engine, telemetry: bool = False):
         """Flat-path analogue of :meth:`update`: fused exchange over the [P]
-        buffer, then the wrapped optimizer on the same buffer."""
-        exchanged, mem_state = engine.exchange(
-            flat_grads, mem_state, key, self.axis_name, self.num_nodes,
-            local_axis=self.local_axis_name, local_size=self.local_size)
+        buffer, then the wrapped optimizer on the same buffer.
+
+        ``telemetry=True`` returns a fourth element — the engine's per-step
+        stat pytree (``dgc_tpu.telemetry``); the default traces nothing
+        extra."""
+        if telemetry:
+            exchanged, mem_state, tstats = engine.exchange(
+                flat_grads, mem_state, key, self.axis_name, self.num_nodes,
+                local_axis=self.local_axis_name, local_size=self.local_size,
+                telemetry=True)
+        else:
+            exchanged, mem_state = engine.exchange(
+                flat_grads, mem_state, key, self.axis_name, self.num_nodes,
+                local_axis=self.local_axis_name, local_size=self.local_size)
         updates, opt_state = self.optimizer.update(exchanged, opt_state,
                                                    flat_params)
+        if telemetry:
+            return updates, opt_state, mem_state, tstats
         return updates, opt_state, mem_state
 
     # ------------------------------------------------------------------ #

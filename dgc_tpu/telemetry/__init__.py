@@ -1,0 +1,36 @@
+"""Compression-health telemetry for the DGC stack.
+
+Three layers, one schema (``registry``):
+
+* :mod:`dgc_tpu.telemetry.taps` — in-graph stat collection: a small pytree
+  of per-step device scalars computed inside the jitted train/bench step and
+  returned as an aux metrics output. Zero added host syncs or dispatches —
+  the stats ride the step's existing outputs; ``telemetry=off`` never traces
+  them at all.
+* :mod:`dgc_tpu.telemetry.sink` — host-side async drain: a background
+  thread pulls completed step-stat device buffers and appends
+  schema-versioned JSONL (with rotation), plus CSV/summary readers.
+* :mod:`dgc_tpu.telemetry.regress` — CLI regression gate comparing a fresh
+  bench/telemetry run against a recorded baseline
+  (``python -m dgc_tpu.telemetry.regress BENCH_r05.json runs/new.jsonl``).
+
+See docs/TELEMETRY.md.
+"""
+
+from dgc_tpu.telemetry.registry import (
+    RUN_METRICS,
+    SCHEMA,
+    SCHEMA_VERSION,
+    STEP_METRICS,
+    MetricSpec,
+    make_header,
+    step_out_specs,
+    step_stat_names,
+)
+from dgc_tpu.telemetry.sink import TelemetrySink, read_run, summarize
+
+__all__ = [
+    "MetricSpec", "SCHEMA", "SCHEMA_VERSION", "STEP_METRICS", "RUN_METRICS",
+    "make_header", "step_stat_names", "step_out_specs",
+    "TelemetrySink", "read_run", "summarize",
+]

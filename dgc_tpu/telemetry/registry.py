@@ -1,0 +1,116 @@
+"""Declarative metric schema shared by taps, sinks, and readers.
+
+One source of truth: the tap builders (:mod:`dgc_tpu.telemetry.taps`, the
+engine's ``exchange(..., telemetry=True)``) emit exactly the ``STEP_METRICS``
+names, the sink writes them under the versioned ``SCHEMA`` header, and the
+regression gate (:mod:`dgc_tpu.telemetry.regress`) compares the
+``RUN_METRICS`` summary keys by their declared ``better`` direction. Readers
+that see an unknown schema version fail loudly instead of misparsing.
+"""
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+__all__ = [
+    "SCHEMA", "SCHEMA_VERSION", "MetricSpec", "STEP_METRICS", "RUN_METRICS",
+    "step_stat_names", "spec_by_name", "step_out_specs", "make_header",
+    "validate_step_stats",
+]
+
+#: schema family tag written into every sink header
+SCHEMA = "dgc-telemetry"
+#: bump on any incompatible change to STEP_METRICS/record layout
+SCHEMA_VERSION = 1
+
+
+class MetricSpec(NamedTuple):
+    """One metric column.
+
+    ``kind`` — "scalar" (one f32 per step) or "per_bucket" (one value per
+    size bucket of the flat engine, variable length across engine rebuilds).
+    ``better`` — regression direction for the gate: "lower", "higher", or
+    "" for purely informational columns the gate never compares.
+    """
+    name: str
+    kind: str
+    description: str
+    better: str = ""
+
+
+#: per-step stats emitted by the in-graph taps (engine + step builder).
+STEP_METRICS: Tuple[MetricSpec, ...] = (
+    MetricSpec("grad_norm", "scalar",
+               "L2 norm of the local flat gradient entering the exchange"),
+    MetricSpec("momentum_norm", "scalar",
+               "L2 norm of the DGC momentum buffers (compressed + dense)"),
+    MetricSpec("residual_norm", "scalar",
+               "L2 norm of the untransmitted error-feedback residual after "
+               "this step's selection"),
+    MetricSpec("clip_delta", "scalar",
+               "relative gradient-norm reduction from clipping this step "
+               "(0 when clipping is off or did not bind)"),
+    MetricSpec("payload_elems", "scalar",
+               "real (non-sentinel) transmitted elements this step, per "
+               "worker", better="lower"),
+    MetricSpec("wire_bytes", "scalar",
+               "per-worker sparse wire bytes per step (values + indices + "
+               "scales; 0 on the dense path)", better="lower"),
+    MetricSpec("selected_frac", "per_bucket",
+               "real selected elements / bucket numel — should track the "
+               "configured compress ratio"),
+    MetricSpec("threshold", "per_bucket",
+               "effective top-k threshold: min |transmitted value| over the "
+               "bucket's real payload slots"),
+)
+
+#: run-level summary keys the regression gate compares (step time and
+#: overhead come from bench records; wire volume from either source).
+RUN_METRICS: Tuple[MetricSpec, ...] = (
+    MetricSpec("step_time_ms", "scalar",
+               "median full train-step wall clock", better="lower"),
+    MetricSpec("overhead_ms", "scalar",
+               "paired DGC-minus-dense per-step overhead", better="lower"),
+    MetricSpec("exchange_ms", "scalar",
+               "modeled sparse exchange time on the reference fabric",
+               better="lower"),
+    MetricSpec("wire_bytes", "scalar",
+               "per-worker sparse wire bytes per step", better="lower"),
+    MetricSpec("payload_elems", "scalar",
+               "per-worker transmitted elements per step", better="lower"),
+)
+
+
+def step_stat_names() -> Tuple[str, ...]:
+    return tuple(s.name for s in STEP_METRICS)
+
+
+def spec_by_name() -> Dict[str, MetricSpec]:
+    seen: Dict[str, MetricSpec] = {}
+    for s in STEP_METRICS + RUN_METRICS:
+        seen.setdefault(s.name, s)
+    return seen
+
+
+def step_out_specs(spec_fn):
+    """Out-spec pytree for the step's telemetry aux output: ``spec_fn()``
+    is called once per metric (e.g. ``lambda: PartitionSpec()``) so the
+    shard_map out_specs always match the taps' dict structure."""
+    return {s.name: spec_fn() for s in STEP_METRICS}
+
+
+def validate_step_stats(stats: Dict) -> None:
+    """Fail loudly when a tap emits a dict that drifts from the schema."""
+    got, want = set(stats), set(step_stat_names())
+    if got != want:
+        raise ValueError(
+            f"telemetry step stats drifted from the registry schema: "
+            f"missing={sorted(want - got)} extra={sorted(got - want)}")
+
+
+def make_header(static: Optional[Dict] = None) -> Dict:
+    """Versioned JSONL header row (first line of every sink file)."""
+    return {
+        "schema": SCHEMA,
+        "version": SCHEMA_VERSION,
+        "metrics": [s._asdict() for s in STEP_METRICS],
+        "static": dict(static or {}),
+    }

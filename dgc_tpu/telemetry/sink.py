@@ -1,0 +1,259 @@
+"""Host-side async telemetry drain + JSONL readers.
+
+``TelemetrySink`` owns one background thread. The train loop hands it the
+step's telemetry aux pytree — still *device* arrays, typically not yet
+computed — and returns immediately; the drain thread performs the blocking
+device→host transfer (``np.asarray`` waits for the buffer to complete) and
+appends one JSON line per step. The main thread therefore never adds a host
+sync: by the time the drain thread touches a buffer the step that produced
+it has long been dispatched, and draining overlaps subsequent steps.
+
+File format (schema-versioned, see :mod:`dgc_tpu.telemetry.registry`):
+
+* line 1 — header: ``{"schema": "dgc-telemetry", "version": 1,
+  "metrics": [...], "static": {...}}``
+* then one record per line: ``{"step": n, **scalars, per_bucket: [...]}``.
+  Free-form event records (``sink.write_record``) carry an ``"event"`` key.
+
+Rotation: when the current file exceeds ``rotate_bytes`` the sink closes it
+and opens ``<base>.N.jsonl`` (N = 1, 2, ...), re-writing the header so every
+file is self-describing.
+
+CLI summary / CSV view::
+
+    python -m dgc_tpu.telemetry.sink runs/telemetry.jsonl [--csv out.csv]
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dgc_tpu.telemetry import registry
+
+__all__ = ["TelemetrySink", "read_run", "summarize", "to_csv"]
+
+_CLOSE = object()
+
+
+def _jsonable(v: Any) -> Any:
+    a = np.asarray(v)          # blocks (drain thread only) until computed
+    if a.ndim == 0:
+        f = float(a)
+        return int(f) if float(f).is_integer() and abs(f) < 2**53 else f
+    return [float(x) for x in a.reshape(-1)]
+
+
+class TelemetrySink:
+    """Async JSONL sink for per-step telemetry stats.
+
+    ``path`` — a ``.jsonl`` file path, or a directory (the sink then writes
+    ``<path>/telemetry.jsonl``). ``static`` goes into the header verbatim
+    (engine geometry, run config). ``enabled=False`` turns every method into
+    a no-op — the non-coordinator processes of a multi-host run.
+    """
+
+    def __init__(self, path: str, static: Optional[Dict] = None,
+                 rotate_bytes: int = 64 << 20, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._static = dict(static or {})
+        self._rotate_bytes = int(rotate_bytes)
+        self._rotations = 0
+        self._dropped = 0
+        self._fh = None
+        if not self.enabled:
+            return
+        if path.endswith(".jsonl"):
+            base = path
+        else:
+            base = os.path.join(path, "telemetry.jsonl")
+        os.makedirs(os.path.dirname(os.path.abspath(base)), exist_ok=True)
+        self._base = base
+        self._open_file(base)
+        self._q: "queue.Queue" = queue.Queue(maxsize=4096)
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="dgc-telemetry-sink")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def path(self) -> Optional[str]:
+        return getattr(self, "_base", None) if self.enabled else None
+
+    def write(self, step: int, stats: Dict[str, Any]) -> None:
+        """Enqueue one step's stat pytree (device arrays OK — the transfer
+        happens on the drain thread). Never blocks the caller: if the queue
+        is full (the drain thread fell behind) the record is dropped and
+        counted rather than stalling the train loop."""
+        if not self.enabled:
+            return
+        self._put({"step": int(step), "_stats": stats})
+
+    def write_record(self, record: Dict[str, Any]) -> None:
+        """Enqueue a free-form event record (engine rebuilds, run summary
+        rows for the regression gate, ...)."""
+        if not self.enabled:
+            return
+        self._put(dict(record))
+
+    def flush(self) -> None:
+        if not self.enabled:
+            return
+        self._q.join()
+        self._fh.flush()
+
+    def close(self) -> None:
+        if not self.enabled or self._fh is None:
+            return
+        self._q.put(_CLOSE)
+        self._thread.join(timeout=60)
+        if self._dropped:
+            self._fh.write(json.dumps(
+                {"event": "sink_dropped", "count": self._dropped}) + "\n")
+        self._fh.close()
+        self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------ #
+
+    def _put(self, item: Dict) -> None:
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            self._dropped += 1
+
+    def _open_file(self, path: str) -> None:
+        self._fh = open(path, "w")
+        self._fh.write(json.dumps(registry.make_header(self._static)) + "\n")
+        self._fh.flush()
+
+    def _maybe_rotate(self) -> None:
+        if self._fh.tell() < self._rotate_bytes:
+            return
+        self._fh.close()
+        self._rotations += 1
+        root, ext = os.path.splitext(self._base)
+        self._open_file(f"{root}.{self._rotations}{ext}")
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is _CLOSE:
+                    return
+                stats = item.pop("_stats", None)
+                if stats is not None:
+                    item.update({k: _jsonable(v) for k, v in stats.items()})
+                item.setdefault("t_host", round(time.time(), 3))
+                self._maybe_rotate()
+                self._fh.write(json.dumps(item) + "\n")
+            except Exception:
+                self._dropped += 1
+            finally:
+                self._q.task_done()
+
+
+# ---------------------------------------------------------------------- #
+# readers                                                                #
+# ---------------------------------------------------------------------- #
+
+def read_run(path: str) -> Tuple[Dict, List[Dict]]:
+    """Read one sink file -> (header, records). Raises on an unknown
+    schema version rather than misparsing."""
+    with open(path) as fh:
+        lines = [json.loads(ln) for ln in fh if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty telemetry file")
+    header, records = lines[0], lines[1:]
+    if header.get("schema") != registry.SCHEMA:
+        # not a sink file — let callers decide (regress handles bench JSON)
+        raise ValueError(f"{path}: not a {registry.SCHEMA} file "
+                         f"(schema={header.get('schema')!r})")
+    if header.get("version") != registry.SCHEMA_VERSION:
+        raise ValueError(f"{path}: schema version {header.get('version')} "
+                         f"(reader supports {registry.SCHEMA_VERSION})")
+    return header, records
+
+
+def summarize(records: List[Dict]) -> Dict[str, Dict[str, float]]:
+    """Per-metric summary over step/event records: median, mean, min, max,
+    last, n. Per-bucket lists summarize their sum (the whole-model view);
+    non-numeric fields are skipped."""
+    cols: Dict[str, List[float]] = {}
+    for r in records:
+        for k, v in r.items():
+            if k in ("step", "t_host", "event"):
+                continue
+            if isinstance(v, bool):
+                continue
+            if isinstance(v, (int, float)):
+                cols.setdefault(k, []).append(float(v))
+            elif (isinstance(v, list) and v
+                  and all(isinstance(x, (int, float)) for x in v)):
+                cols.setdefault(k, []).append(float(np.sum(v)))
+    return {
+        k: {"median": float(np.median(v)), "mean": float(np.mean(v)),
+            "min": float(np.min(v)), "max": float(np.max(v)),
+            "last": v[-1], "n": len(v)}
+        for k, v in cols.items()
+    }
+
+
+def to_csv(path: str, out: str) -> None:
+    """Flatten a sink file to CSV (per-bucket columns suffixed _0.._n)."""
+    _, records = read_run(path)
+    rows = []
+    for r in records:
+        if "event" in r:
+            continue
+        flat: Dict[str, float] = {}
+        for k, v in r.items():
+            if isinstance(v, list):
+                for i, x in enumerate(v):
+                    flat[f"{k}_{i}"] = x
+            else:
+                flat[k] = v
+        rows.append(flat)
+    keys: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in keys:
+                keys.append(k)
+    with open(out, "w") as fh:
+        fh.write(",".join(keys) + "\n")
+        for r in rows:
+            fh.write(",".join(str(r.get(k, "")) for k in keys) + "\n")
+
+
+def _main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m dgc_tpu.telemetry.sink",
+        description="summarize a telemetry JSONL run")
+    ap.add_argument("run", help="telemetry .jsonl file")
+    ap.add_argument("--csv", help="also write a flattened CSV view")
+    args = ap.parse_args(argv)
+    header, records = read_run(args.run)
+    print(f"# {args.run}: schema {header['schema']}/v{header['version']}, "
+          f"{len(records)} records")
+    for k, s in sorted(summarize(records).items()):
+        print(f"{k:>16}: median={s['median']:.6g} mean={s['mean']:.6g} "
+              f"min={s['min']:.6g} max={s['max']:.6g} n={s['n']}")
+    if args.csv:
+        to_csv(args.run, args.csv)
+        print(f"wrote {args.csv}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -1,0 +1,95 @@
+"""In-graph stat taps: pure jnp helpers the engine and step builder call
+*inside* the jitted step when telemetry is on.
+
+Hard constraint (ISSUE 2 / docs/TELEMETRY.md): **zero added host syncs or
+dispatches**. Everything here returns device scalars (or tiny [num_buckets]
+vectors) that ride the step's existing aux outputs — the host never reads
+them synchronously; the async sink drains completed buffers on a background
+thread. With ``telemetry=False`` none of these functions is even traced, so
+the compiled program is the pre-telemetry HLO.
+
+The taps deliberately reuse intermediates the exchange already materializes
+(the emitted payload, the post-compensate velocity) — the only new work is
+a handful of reductions, which XLA fuses into the surrounding passes.
+"""
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.telemetry import registry
+
+__all__ = ["l2", "bucket_payload_stats", "assemble_step_stats",
+           "empty_bucket_stats", "pmean_stats"]
+
+
+def l2(x: Optional[jax.Array]) -> jax.Array:
+    """f32 L2 norm; 0 for None/empty (the dense-baseline engines)."""
+    if x is None or x.size == 0:
+        return jnp.zeros((), jnp.float32)
+    xf = x.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(xf * xf))
+
+
+def bucket_payload_stats(vals: jax.Array, gidx: jax.Array, sentinel: int):
+    """(real_count, effective_threshold) for one bucket's emitted payload.
+
+    The effective threshold is the min |value| over real (non-sentinel)
+    slots — exactly the quantity the sampled-top-k threshold estimates; 0
+    when the bucket transmitted nothing this step.
+    """
+    valid = gidx != sentinel
+    count = jnp.sum(valid).astype(jnp.float32)
+    absv = jnp.abs(vals.astype(jnp.float32))
+    thr = jnp.min(jnp.where(valid, absv, jnp.inf))
+    return count, jnp.where(count > 0, thr, 0.0)
+
+
+def empty_bucket_stats(num_buckets: int = 0) -> Dict[str, jax.Array]:
+    """Per-bucket stat arrays for engines with no sparse payload."""
+    z = jnp.zeros((num_buckets,), jnp.float32)
+    return {"selected_frac": z, "threshold": z,
+            "payload_elems": jnp.zeros((), jnp.float32)}
+
+
+def assemble_step_stats(*, grad_norm, momentum_norm, residual_norm,
+                        clip_delta, payload_elems, wire_bytes,
+                        selected_frac, threshold) -> Dict[str, jax.Array]:
+    """Assemble + schema-check the per-step stat pytree (registry names)."""
+    stats = {
+        "grad_norm": grad_norm,
+        "momentum_norm": momentum_norm,
+        "residual_norm": residual_norm,
+        "clip_delta": clip_delta,
+        "payload_elems": payload_elems,
+        "wire_bytes": wire_bytes,
+        "selected_frac": selected_frac,
+        "threshold": threshold,
+    }
+    registry.validate_step_stats(stats)
+    return {k: jnp.asarray(v, jnp.float32) for k, v in stats.items()}
+
+
+def pmean_stats(stats: Dict[str, jax.Array],
+                axes: Sequence[str]) -> Dict[str, jax.Array]:
+    """Mean the per-worker stats over the mesh axes so the step can return
+    them replicated (P() out-specs) like the loss.
+
+    Packs every stat into ONE flat vector first so the whole tree costs a
+    single tiny pmean, not one collective per leaf — leaf-wise pmean was
+    ~8 serialized all-reduces, measurable even on the CPU fake-device
+    backend and pure waste on real fabric.
+    """
+    axes = tuple(axes)
+    leaves, treedef = jax.tree.flatten(stats)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
+    packed = jnp.concatenate([l.reshape(-1) for l in leaves])
+    packed = jax.lax.pmean(packed, axes)
+    out, off = [], 0
+    for shape, size in zip(shapes, sizes):
+        out.append(packed[off:off + size].reshape(shape))
+        off += size
+    return jax.tree.unflatten(treedef, out)

@@ -18,6 +18,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -79,6 +80,23 @@ def shard_state(state: TrainState, mesh: Mesh, axis="data",
                 "not both")
         per_worker_opt = getattr(dist_opt, "per_worker_opt_state", False)
     specs = state_specs(state, axis, bool(per_worker_opt))
+    if jax.process_count() > 1:
+        # device_put onto a pod-spanning sharding routes every leaf
+        # through multihost_utils.assert_equal — one gloo broadcast per
+        # leaf to check the hosts agree. Initial state is deterministic
+        # and identical on every process (same seed, same code), so the
+        # check buys nothing, and its broadcasts can interleave with a
+        # previous step's still-draining collectives on the shared gloo
+        # communicator, aborting the run with
+        # "op.preamble.length <= op.nbytes". Assemble the global arrays
+        # collective-free from process-local shards instead — the same
+        # contract host_local_to_global uses for batch assembly.
+        def place(x, sp):
+            host = np.asarray(jax.device_get(x))
+            return jax.make_array_from_callback(
+                host.shape, NamedSharding(mesh, sp),
+                lambda idx, h=host: h[idx])
+        return jax.tree.map(place, state, specs)
     return jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
         state, specs)

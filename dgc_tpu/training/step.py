@@ -113,7 +113,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                      mesh: Mesh, num_batches_per_step: int = 1,
                      use_dropout: bool = False, donate: bool = True,
                      flat: Optional[FlatSetup] = None,
-                     model_dtype=None):
+                     model_dtype=None, telemetry: bool = False):
     """Build the jitted data-parallel DGC train step.
 
     Returns ``step_fn(state, images, labels, key) -> (state, metrics)`` where
@@ -141,7 +141,18 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     Both paths share ONE worker implementation, parameterized only on how
     params/grads/stats are represented and which update entrypoint runs —
     so their numerics cannot drift apart.
+
+    ``telemetry=True`` (flat path only): the metrics dict gains a
+    ``"telemetry"`` pytree of per-step compression-health scalars
+    (``dgc_tpu.telemetry.registry.STEP_METRICS``, pmean'd over the mesh) as
+    an aux output of the SAME jitted program — zero extra host syncs or
+    dispatches; feed it to :class:`dgc_tpu.telemetry.sink.TelemetrySink`.
+    The default ``False`` traces none of it, leaving the compiled step
+    byte-identical to the pre-telemetry program.
     """
+    if telemetry and flat is None:
+        raise ValueError("telemetry taps require the flat engine path "
+                         "(pass flat=make_flat_setup(...))")
     loss_fn = make_loss_fn(apply_fn)
     world = dist_opt.world_size
     axes = dist_opt.data_axes      # (axis,) flat, (hosts, local) two-tier
@@ -158,9 +169,14 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         pack_stats = stats_layout.flatten
 
         def do_update(grads, params, opt_state, memory, key):
+            if telemetry:
+                upd, opt_state, memory, tstats = dist_opt.update_flat(
+                    grads, opt_state, params, memory, key, engine,
+                    telemetry=True)
+                return params + upd, opt_state, memory, tstats
             upd, opt_state, memory = dist_opt.update_flat(
                 grads, opt_state, params, memory, key, engine)
-            return params + upd, opt_state, memory
+            return params + upd, opt_state, memory, None
     else:
         unpack_params = unpack_stats = pack_grads = pack_stats = (
             lambda x: x)
@@ -168,7 +184,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         def do_update(grads, params, opt_state, memory, key):
             upd, opt_state, memory = dist_opt.update(
                 grads, opt_state, params, memory, key)
-            return optax.apply_updates(params, upd), opt_state, memory
+            return optax.apply_updates(params, upd), opt_state, memory, None
 
     per_worker_opt = dist_opt.per_worker_opt_state
 
@@ -266,10 +282,16 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
         opt_state = (_squeeze0(state.opt_state) if per_worker_opt
                      else state.opt_state)
-        new_params, opt_state, memory = do_update(
+        new_params, opt_state, memory, tstats = do_update(
             grads, state.params, opt_state, memory, sparsify_key)
 
         mean_loss = jax.lax.psum(loss, axes) / world
+        metrics = {"loss": mean_loss}
+        if telemetry:
+            # per-worker stats -> replicated (mesh mean), matching the
+            # loss: the collective rides the same program (no dispatch)
+            from dgc_tpu.telemetry import taps
+            metrics["telemetry"] = taps.pmean_stats(tstats, axes)
 
         new_state = TrainState(
             step=state.step + 1,
@@ -279,7 +301,12 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             memory=_expand0(memory),
             batch_stats=_expand0(packed_stats),
         )
-        return new_state, {"loss": mean_loss}
+        return new_state, metrics
+
+    metric_specs = {"loss": P()}
+    if telemetry:
+        from dgc_tpu.telemetry import registry
+        metric_specs["telemetry"] = registry.step_out_specs(P)
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step_fn(state, images, labels, key):
@@ -287,7 +314,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         sharded = shard_map(
             worker, mesh=mesh,
             in_specs=(specs, P(axes), P(axes), P()),
-            out_specs=(specs, {"loss": P()}),
+            out_specs=(specs, metric_specs),
             check_vma=False)
         return sharded(state, images, labels, key)
 

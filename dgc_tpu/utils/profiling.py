@@ -78,7 +78,7 @@ def exchange_report(dgc_ms: float, dense_ms: float, payload_elems: int,
         "dgc_exchange_ms": overhead + dgc_wire_ms,
         "dgc_wire_ms": dgc_wire_ms,
         "dgc_compute_overhead_ms": overhead,
-        "speedup": dense_wire_ms / (overhead + dgc_wire_ms),
+        "speedup": dense_wire_ms / max(overhead + dgc_wire_ms, 1e-12),
         "wire_reduction": (2 * 4 * num_params * (workers - 1) / workers) /
                           max((workers - 1) * payload_elems * 8, 1),
     }

@@ -24,6 +24,8 @@ Execution design for the relay-attached single v5e chip:
 Usage:
   python scripts/accuracy_parity.py --arms dense,dgc --epochs 150
   python scripts/accuracy_parity.py --arms dgc,dgc_exact --ratio 0.001
+  python scripts/accuracy_parity.py --arms dense,dgc,dgc_int8pack \
+      --seeds 3 --telemetry-out runs/parity.jsonl   # multi-seed parity
 """
 
 import argparse
@@ -97,12 +99,15 @@ def build_arm(arm, variables, lr_sched, world, ratio, warmup_epochs, args):
         mem_dtype = "bfloat16" if arm == "dgc_bf16mem" else None
         # "dgc_int8" is the SHIPPED int8 wire (error feedback on, the
         # round-4 default); "dgc_int8nofb" is the no-feedback control
-        # (the round-3 behavior, int8_error_feedback=False)
+        # (the round-3 behavior, int8_error_feedback=False);
+        # "dgc_int8pack" adds the bit-packed index wire on top of int8
+        # values — the full minimum-wire configuration
         comp = DGCCompressor(
             ratio, memory=DGCSGDMemory(momentum=0.9, dtype=mem_dtype),
             warmup_epochs=warmup_epochs,
             int8_values=arm.startswith("dgc_int8"),
             int8_error_feedback=(arm != "dgc_int8nofb"),
+            packed_indices=(arm == "dgc_int8pack"),
             approx_recall=recall)
         from dgc_tpu.utils.pytree import named_flatten
         named, _ = named_flatten(variables["params"])
@@ -137,7 +142,13 @@ def main():
                     help="force exact top-k selection (approx_recall=None)")
     ap.add_argument("--eval-every", type=int, default=5)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="run each arm at seeds seed..seed+N-1 and report "
+                         "mean +/- spread (ISSUE 2 multi-seed parity)")
     ap.add_argument("--json-out", default=None)
+    ap.add_argument("--telemetry-out", default=None,
+                    help="also log per-(arm, seed) results through the "
+                         "telemetry sink (dgc_tpu.telemetry.sink JSONL)")
     args = ap.parse_args()
     if args.exact_select:
         args.approx_recall = None
@@ -165,10 +176,12 @@ def main():
     model = resnet20(num_classes=args.classes)
     loss_fn = make_loss_fn(model.apply)
 
-    results = {}
-    for arm in args.arms.split(","):
+    seed_list = [args.seed + i for i in range(args.seeds)]
+    runs = {}          # (arm, seed) -> result dict
+    for arm, seed in [(a, s) for a in args.arms.split(",")
+                      for s in seed_list]:
         t_arm = time.time()
-        variables = model.init(jax.random.PRNGKey(args.seed),
+        variables = model.init(jax.random.PRNGKey(seed),
                                jnp.zeros((1, 32, 32, 3)), train=True)
         lr_sched = make_lr_schedule(
             args.lr, W, steps_per_epoch, warmup_lr_epochs=5,
@@ -264,28 +277,66 @@ def main():
                 epoch_fn = make_epoch_fn(engine)  # re-jit (<=6 ratios)
             flat_params, stats_w, mem_w, opt_state, loss = epoch_fn(
                 flat_params, stats_w, mem_w, opt_state,
-                jax.random.fold_in(jax.random.PRNGKey(args.seed + 77),
+                jax.random.fold_in(jax.random.PRNGKey(seed + 77),
                                    epoch))
             if epoch == 0:
-                print(f"[{arm}] first epoch dispatched "
+                print(f"[{arm} s{seed}] first epoch dispatched "
                       f"({time.time() - t_arm:.0f}s incl. compile)",
                       file=sys.stderr, flush=True)
             if (epoch + 1) % args.eval_every == 0 or epoch == args.epochs - 1:
                 acc = float(eval_fn(flat_params, stats_w[0]))
                 curve.append((epoch, float(loss), acc))
-                print(f"[{arm}] epoch {epoch:3d} loss {float(loss):.4f} "
-                      f"top1 {acc * 100:.2f}%"
+                print(f"[{arm} s{seed}] epoch {epoch:3d} "
+                      f"loss {float(loss):.4f} top1 {acc * 100:.2f}%"
                       + (f" ratio {comp.compress_ratio}"
                          if arm != "dense" else ""),
                       file=sys.stderr, flush=True)
         last3 = [a for _, _, a in curve[-3:]]
-        results[arm] = {"final_top1": curve[-1][2],
-                        "mean_last3_top1": float(np.mean(last3)),
-                        "curve": curve,
-                        "wall_s": round(time.time() - t_arm, 1)}
-        print(f"[{arm}] done in {results[arm]['wall_s']}s "
+        runs[(arm, seed)] = {"final_top1": curve[-1][2],
+                             "mean_last3_top1": float(np.mean(last3)),
+                             "curve": curve,
+                             "wall_s": round(time.time() - t_arm, 1)}
+        print(f"[{arm} s{seed}] done in {runs[(arm, seed)]['wall_s']}s "
               f"final top1 {curve[-1][2] * 100:.2f}% "
               f"(mean of last 3 evals {np.mean(last3) * 100:.2f}%)",
+              file=sys.stderr)
+
+    # aggregate across seeds: single-seed output keeps the legacy per-arm
+    # shape; multi-seed adds mean +/- spread over the seed axis
+    results = {}
+    for arm in args.arms.split(","):
+        per_seed = {s: runs[(arm, s)] for s in seed_list}
+        if args.seeds == 1:
+            results[arm] = per_seed[seed_list[0]]
+            continue
+        finals = [per_seed[s]["mean_last3_top1"] for s in seed_list]
+        results[arm] = {
+            "seeds": {str(s): per_seed[s] for s in seed_list},
+            "final_top1": float(np.mean(
+                [per_seed[s]["final_top1"] for s in seed_list])),
+            "mean_last3_top1": float(np.mean(finals)),
+            "spread_last3_top1": float(np.max(finals) - np.min(finals)),
+            "std_last3_top1": float(np.std(finals)),
+        }
+        print(f"[{arm}] over {args.seeds} seeds: mean_last3 "
+              f"{np.mean(finals) * 100:.2f}% +/- "
+              f"{np.std(finals) * 100:.2f}% (spread "
+              f"{(np.max(finals) - np.min(finals)) * 100:.2f}pp)",
+              file=sys.stderr)
+
+    if args.telemetry_out:
+        from dgc_tpu.telemetry.sink import TelemetrySink
+        with TelemetrySink(args.telemetry_out, static={
+                "experiment": "accuracy_parity", "ratio": args.ratio,
+                "workers": W, "epochs": args.epochs,
+                "arms": args.arms.split(","), "seeds": seed_list}) as sk:
+            for (arm, seed), r in runs.items():
+                sk.write_record({
+                    "event": "parity_arm", "arm": arm, "seed": seed,
+                    "final_top1": r["final_top1"],
+                    "mean_last3_top1": r["mean_last3_top1"],
+                    "wall_s": r["wall_s"]})
+        print(f"telemetry run written: {args.telemetry_out}",
               file=sys.stderr)
 
     print(json.dumps(results))

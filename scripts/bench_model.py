@@ -49,6 +49,16 @@ def main():
                          "arms build the model with dtype=bf16 and the "
                          "step casts the flat parameter buffer once "
                          "(build_train_step model_dtype)")
+    ap.add_argument("--telemetry-ab", action="store_true",
+                    help="pair dgc+telemetry against plain dgc instead of "
+                         "dgc vs dense: measures the in-graph telemetry "
+                         "taps' overhead (ISSUE 2 gate: <= 1% of step "
+                         "time). Both arms consume their metric outputs "
+                         "so nothing is dead-code-eliminated.")
+    ap.add_argument("--telemetry-out", default=None,
+                    help="write a telemetry JSONL run summary (sink "
+                         "schema) for the regression gate: python -m "
+                         "dgc_tpu.telemetry.regress BASELINE <path>")
     ap.add_argument("--mode", default="scan", choices=["scan", "dispatch"],
                     help="scan: K steps in one lax.scan dispatch (the "
                          "conservative default — its while-loop carry "
@@ -100,7 +110,7 @@ def main():
             return state, m["loss"]
         return run
 
-    def prepare(dist):
+    def prepare(dist, telemetry=False, consume=False):
         setup = make_flat_setup(v, dist)
         state = shard_state(make_flat_state(v, dist, setup, W), mesh,
                             dist_opt=dist)
@@ -108,37 +118,70 @@ def main():
                                 use_dropout="vgg" in args.model,
                                 flat=setup,
                                 model_dtype=(jnp.bfloat16 if args.bf16
-                                             else None))
+                                             else None),
+                                telemetry=telemetry)
         loop = (make_dispatch_loop(step, args.k) if dispatch
-                else bench._make_k_loop(step, images, labels, args.k))
+                else bench._make_k_loop(step, images, labels, args.k,
+                                        consume_metrics=consume))
         return (loop, state), setup
 
-    comp = DGCCompressor(args.ratio, memory=DGCSGDMemory(
-        momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8,
-        int8_error_feedback=not args.no_int8_ef,
-        fused_apply=args.fused_apply)
-    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
-    dgc_run, setup = prepare(DistributedOptimizer(
-        dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))
-    dense_run, _ = prepare(DistributedOptimizer(
-        sgd(0.1, momentum=0.9, weight_decay=1e-4), Compression.none(),
-        world_size=W))
+    def mk_comp():
+        c = DGCCompressor(args.ratio, memory=DGCSGDMemory(
+            momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8,
+            int8_error_feedback=not args.no_int8_ef,
+            fused_apply=args.fused_apply)
+        c.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        return c
+
+    def mk_dgc_dist():
+        return DistributedOptimizer(
+            dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), mk_comp(),
+            world_size=W)
+
+    if args.telemetry_ab:
+        a_run, setup = prepare(mk_dgc_dist(), telemetry=True, consume=True)
+        b_run, _ = prepare(mk_dgc_dist(), telemetry=False, consume=True)
+        label = ("dgc+telemetry", "dgc")
+    else:
+        a_run, setup = prepare(mk_dgc_dist())
+        b_run, _ = prepare(DistributedOptimizer(
+            sgd(0.1, momentum=0.9, weight_decay=1e-4), Compression.none(),
+            world_size=W))
+        label = ("dgc", "dense")
     print(f"model={args.model} P={setup.layout.num_params} "
           f"payload={setup.engine.payload_size}", file=sys.stderr)
 
     rows = bench._interleaved_step_ms(
-        [dgc_run, dense_run], rtt, k=args.k, repeats=args.repeats,
+        [a_run, b_run], rtt, k=args.k, repeats=args.repeats,
         max_repeats=3 * args.repeats)
-    dgc_ms, dense_ms = (min(col) for col in zip(*rows))
+    a_ms, b_ms = (min(col) for col in zip(*rows))
     diffs = [d - b for d, b in rows]
     med = statistics.median(diffs)
     q1, q3 = (float(x) for x in np.percentile(diffs, [25, 75]))
-    print(f"dgc step:   {dgc_ms:.3f} ms", file=sys.stderr)
-    print(f"dense step: {dense_ms:.3f} ms", file=sys.stderr)
+    print(f"{label[0]} step:   {a_ms:.3f} ms", file=sys.stderr)
+    print(f"{label[1]} step: {b_ms:.3f} ms", file=sys.stderr)
     print(f"per-round overheads: {[round(x, 3) for x in diffs]}",
           file=sys.stderr)
-    print(f"OVERHEAD median {med:.3f} ms  IQR [{q1:.3f}, {q3:.3f}]  "
-          f"({100 * med / dense_ms:.1f}% of dense step)")
+    print(f"OVERHEAD ({label[0]} - {label[1]}) median {med:.3f} ms  "
+          f"IQR [{q1:.3f}, {q3:.3f}]  "
+          f"({100 * med / b_ms:.1f}% of {label[1]} step)")
+
+    if args.telemetry_out:
+        from dgc_tpu.telemetry.sink import TelemetrySink
+        with TelemetrySink(args.telemetry_out,
+                           static=dict(setup.engine.telemetry_static(),
+                                       model=args.model, mode=args.mode,
+                                       arms=list(label))) as sk:
+            sk.write_record({
+                "event": "run_summary",
+                "step_time_ms": round(a_ms, 4),
+                "baseline_step_ms": round(b_ms, 4),
+                "overhead_ms": round(max(med, 0.0), 4),
+                "wire_bytes": setup.engine.wire_bytes_per_worker(),
+                "payload_elems": setup.engine.payload_size,
+            })
+        print(f"telemetry run written: {args.telemetry_out}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

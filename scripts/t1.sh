@@ -11,4 +11,10 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# telemetry smoke (docs/TELEMETRY.md): one telemetry train step through the
+# async sink, then the regression gate must pass on self-compare — the
+# "fast"-marked subset only, so this stays a few seconds
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "TELEMETRY_SMOKE=ok" || { echo "TELEMETRY_SMOKE=FAIL"; rc=1; }
 exit $rc

@@ -120,10 +120,19 @@ def main():
     images = host_local_to_global(images_h, mesh)
     labels = host_local_to_global(labels_h, mesh)
 
+    # NOTE on the block_until_ready calls below: syncing only the loss
+    # scalar leaves the step's exchange collectives in flight on the async
+    # CPU runtime; if the host then starts a collective sequence of its own
+    # (shard_state / checkpoint device_puts issue assert_equal broadcasts),
+    # the two processes can issue gloo ops in different orders on the shared
+    # communicator and die with "op.preamble.length <= op.nbytes". Fully
+    # draining the device stream before every host-driven collective
+    # sequence removes that race.
     losses = []
     for i in range(3):
         state, m = step_fn(state, images, labels, jax.random.PRNGKey(i))
         losses.append(float(m["loss"]))
+    jax.block_until_ready(state)
 
     # metric writer: only the coordinator creates files
     writer = MetricWriter(os.path.join(workdir, "logs"))
@@ -135,6 +144,7 @@ def main():
 
     # one more step so the live state diverges from the saved one
     state2, _ = step_fn(state, images, labels, jax.random.PRNGKey(99))
+    jax.block_until_ready(state2)
     restored = ckpt.restore(state2)
     assert restored is not None
     r_state, r_epoch, meters = restored
@@ -154,6 +164,7 @@ def main():
     # resumed state trains on
     state3, m3 = step_fn(r_state, images, labels, jax.random.PRNGKey(5))
     assert np.isfinite(float(m3["loss"]))
+    jax.block_until_ready((state3, m3))
 
     # --- two-tier hierarchical exchange across the REAL process boundary:
     # each process is one "host" row (its 4 local devices form the dense
@@ -180,6 +191,96 @@ def main():
                               jax.random.PRNGKey(i))
         tt_losses.append(float(m["loss"]))
     assert all(np.isfinite(tl) for tl in tt_losses)
+    jax.block_until_ready(state_tt)
+
+    # --- 4-host x 2-local two-tier mesh (ISSUE 2 satellite): the hosts
+    # (sparse) axis now CROSSES the process boundary — rows 0-1 live in
+    # proc 0, rows 2-3 in proc 1 — so the dense local tier stays inside a
+    # process while the sparse gather spans both intra- and inter-process
+    # "hosts". Per-node memory semantics: the local tier psums the gradient
+    # before compression, so the two devices of one row must hold bitwise-
+    # identical error-feedback memory at every step, including across
+    # save/resume. ---
+    hosts4, local2 = 4, 2
+    mesh_t4 = make_two_tier_mesh(hosts4, local2)
+    rows_per_proc = hosts4 // num_procs
+    for r in range(hosts4):
+        owner = r // rows_per_proc
+        assert [d.process_index for d in mesh_t4.devices[r]] == \
+            [owner] * local2, "rows must pack per process in order"
+    comp_t4 = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9))
+    comp_t4.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist_t4 = DistributedOptimizer(
+        dgc_sgd(0.1, momentum=0.9), comp_t4, axis_name="hosts",
+        world_size=W, local_axis_name="local", local_size=local2)
+    setup_t4 = make_flat_setup(v, dist_t4)
+    state_t4 = shard_state(make_flat_state(v, dist_t4, setup_t4, W),
+                           mesh_t4, dist_t4.data_axes, dist_opt=dist_t4)
+    # telemetry riding the same program across the real process boundary
+    step_t4 = build_train_step(apply_fn, dist_t4, mesh_t4, donate=False,
+                               flat=setup_t4, telemetry=True)
+    images_t4 = host_local_to_global(images_h, mesh_t4)
+    labels_t4 = host_local_to_global(labels_h, mesh_t4)
+
+    def mem_pair_dev(mem):
+        """Max |memory(row dev 0) - memory(row dev 1)| over all per-worker
+        leaves — 0.0 iff every host row's local pair is bitwise equal."""
+        leaves = [l for l in jax.tree.leaves(mem)
+                  if hasattr(l, "shape") and l.ndim >= 1
+                  and l.shape[0] == W]
+        assert leaves, "memory has no per-worker leaves"
+
+        def f(*ls):
+            d = jnp.zeros((), jnp.float32)
+            for l in ls:
+                r = l.reshape(hosts4, local2, -1).astype(jnp.float32)
+                d = jnp.maximum(d, jnp.max(jnp.abs(r[:, 0] - r[:, 1])))
+            return d
+        return float(jax.jit(f)(*leaves))
+
+    t4_losses, t4_mem_dev = [], []
+    telem = None
+    for i in range(2):
+        state_t4, m = step_t4(state_t4, images_t4, labels_t4,
+                              jax.random.PRNGKey(i))
+        jax.block_until_ready(state_t4)
+        t4_losses.append(float(m["loss"]))
+        t4_mem_dev.append(mem_pair_dev(state_t4.memory))
+        telem = m["telemetry"]
+    assert all(np.isfinite(tl) for tl in t4_losses)
+    t4_payload = float(np.asarray(telem["payload_elems"]))
+    assert np.isfinite(float(np.asarray(telem["grad_norm"])))
+
+    # save/resume preserves the per-node memory pairing across the
+    # process boundary: save, diverge one step, restore, verify
+    ckpt_t4 = CheckpointManager(os.path.join(workdir, "ckpt_tt"), keep=1)
+    ckpt_t4.save(0, state_t4, {"top1": 1.0}, best=False)
+    state_t4b, _ = step_t4(state_t4, images_t4, labels_t4,
+                           jax.random.PRNGKey(77))
+    jax.block_until_ready(state_t4b)
+    restored_t4 = ckpt_t4.restore(state_t4b)
+    assert restored_t4 is not None
+    r_state_t4 = restored_t4[0]
+
+    def mem_max_diff(ma, mb):
+        la = [l for l in jax.tree.leaves(ma) if hasattr(l, "shape")]
+        lb = [l for l in jax.tree.leaves(mb) if hasattr(l, "shape")]
+
+        def f(*ls):
+            n = len(ls) // 2
+            return jnp.max(jnp.stack([
+                jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                b.astype(jnp.float32)))
+                for a, b in zip(ls[:n], ls[n:])]))
+        return float(jax.jit(f)(*(la + lb)))
+
+    t4_restore_diff = mem_max_diff(r_state_t4.memory, state_t4.memory)
+    t4_restored_pair_dev = mem_pair_dev(r_state_t4.memory)
+    state_t4c, m4c = step_t4(r_state_t4, images_t4, labels_t4,
+                             jax.random.PRNGKey(5))
+    jax.block_until_ready((state_t4c, m4c))
+    t4_resumed_pair_dev = mem_pair_dev(state_t4c.memory)
+    assert np.isfinite(float(m4c["loss"]))
 
     print("RESULT:" + json.dumps({
         "proc": proc_id,
@@ -187,6 +288,12 @@ def main():
         "tt_losses": tt_losses,
         "resume_loss": float(m3["loss"]),
         "coordinator": is_coordinator(),
+        "t4_losses": t4_losses,
+        "t4_mem_pair_dev": t4_mem_dev,
+        "t4_payload": t4_payload,
+        "t4_restore_diff": t4_restore_diff,
+        "t4_restored_pair_dev": t4_restored_pair_dev,
+        "t4_resumed_pair_dev": t4_resumed_pair_dev,
     }), flush=True)
 
     # align exits: the coordinator's extra file bookkeeping must not make

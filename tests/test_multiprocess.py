@@ -67,3 +67,20 @@ def test_two_process_train_save_resume(tmp_path):
     assert (tmp_path / "logs" / "metrics.jsonl").exists()
     assert (tmp_path / "ckpt" / "latest.json").exists()
     assert (tmp_path / "ckpt" / "best").exists()
+
+    # --- 4-host x 2-local two-tier mesh: sparse axis crosses the process
+    # boundary (rows 0-1 proc 0, rows 2-3 proc 1) ---
+    assert results[0]["t4_losses"] == results[1]["t4_losses"]
+    assert all(l == l and abs(l) < 1e6 for l in results[0]["t4_losses"])
+    # per-node memory semantics: the local (dense) tier psums the gradient
+    # before compression, so both devices of a host row hold bitwise-
+    # identical error-feedback memory at every step...
+    assert results[0]["t4_mem_pair_dev"] == [0.0, 0.0], \
+        f"per-node memory diverged: {results[0]['t4_mem_pair_dev']}"
+    # ...and the property survives a collective save/resume cycle
+    assert results[0]["t4_restore_diff"] == 0.0
+    assert results[0]["t4_restored_pair_dev"] == 0.0
+    assert results[0]["t4_resumed_pair_dev"] == 0.0
+    # telemetry taps ran inside the cross-process program and agree
+    assert results[0]["t4_payload"] == results[1]["t4_payload"] > 0
+    assert (tmp_path / "ckpt_tt" / "latest.json").exists()

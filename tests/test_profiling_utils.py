@@ -1,0 +1,135 @@
+"""Unit tests for utils/profiling.exchange_report algebra and the
+TopKClassMeter update/data/set/compute protocol (ISSUE 2 satellite).
+
+exchange_report is the north-star accounting bench.py prints — its wire
+model must obey the ring-allreduce / sparse-allgather identities exactly,
+because docs/RESULTS.md quotes its speedup column. TopKClassMeter is the
+reference harness's accuracy meter; its data/set round-trip is what the
+cross-worker Sum reduction relies on.
+"""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.utils.meters import TopKClassMeter
+from dgc_tpu.utils.profiling import exchange_report
+
+
+# --------------------------------------------------------------------- #
+# exchange_report                                                        #
+# --------------------------------------------------------------------- #
+
+def test_exchange_report_wire_model_formulas():
+    P, W, gbps = 1_000_000, 8, 100.0
+    payload = 1000
+    r = exchange_report(dgc_ms=2.0, dense_ms=1.5, payload_elems=payload,
+                        num_params=P, workers=W, fabric_gbps=gbps)
+    # ring allreduce moves 2*(W-1)/W * 4 bytes per param
+    dense_bytes = 2 * 4 * P * (W - 1) / W
+    assert r["dense_exchange_ms"] == pytest.approx(
+        dense_bytes / (gbps * 1e9) * 1e3)
+    # sparse allgather moves (W-1) * payload * (4B value + 4B index)
+    sparse_bytes = (W - 1) * payload * 8
+    assert r["dgc_wire_ms"] == pytest.approx(
+        sparse_bytes / (gbps * 1e9) * 1e3)
+    assert r["wire_reduction"] == pytest.approx(dense_bytes / sparse_bytes)
+
+
+def test_exchange_report_identities():
+    r = exchange_report(dgc_ms=3.25, dense_ms=2.0, payload_elems=512,
+                        num_params=500_000, workers=4, fabric_gbps=50.0)
+    # measured overhead is the paired step-time difference
+    assert r["dgc_compute_overhead_ms"] == pytest.approx(3.25 - 2.0)
+    # total dgc exchange = compute overhead + modeled wire time
+    assert r["dgc_exchange_ms"] == pytest.approx(
+        r["dgc_compute_overhead_ms"] + r["dgc_wire_ms"])
+    # speedup is defined against that total
+    assert r["speedup"] * r["dgc_exchange_ms"] == pytest.approx(
+        r["dense_exchange_ms"])
+
+
+def test_exchange_report_negative_overhead_clamps():
+    # DGC arm measured faster than dense (noise): overhead clamps to 0 so
+    # the exchange total is pure wire time, never negative.
+    r = exchange_report(dgc_ms=1.0, dense_ms=2.0, payload_elems=100,
+                        num_params=100_000, workers=8, fabric_gbps=100.0)
+    assert r["dgc_compute_overhead_ms"] == 0.0
+    assert r["dgc_exchange_ms"] == pytest.approx(r["dgc_wire_ms"])
+    assert r["speedup"] > 0
+
+
+def test_exchange_report_zero_payload_no_div_by_zero():
+    r = exchange_report(dgc_ms=1.0, dense_ms=1.0, payload_elems=0,
+                        num_params=100_000, workers=8, fabric_gbps=100.0)
+    assert np.isfinite(r["wire_reduction"])
+    assert r["dgc_wire_ms"] == 0.0
+
+
+def test_exchange_report_wire_reduction_tracks_ratio():
+    # halving the payload doubles the wire reduction (pure algebra)
+    kw = dict(dgc_ms=1.0, dense_ms=1.0, num_params=1_000_000, workers=8,
+              fabric_gbps=100.0)
+    r1 = exchange_report(payload_elems=2000, **kw)
+    r2 = exchange_report(payload_elems=1000, **kw)
+    assert r2["wire_reduction"] == pytest.approx(2 * r1["wire_reduction"])
+
+
+# --------------------------------------------------------------------- #
+# TopKClassMeter                                                         #
+# --------------------------------------------------------------------- #
+
+def test_topk_meter_top1_known_batch():
+    m = TopKClassMeter(k=1)
+    outputs = np.array([[0.1, 0.9, 0.0],    # pred 1
+                        [0.8, 0.1, 0.1],    # pred 0
+                        [0.2, 0.3, 0.5]])   # pred 2
+    targets = np.array([1, 2, 2])           # correct, wrong, correct
+    m.update(outputs, targets)
+    assert m.data() == {"num_correct": 2, "num_examples": 3}
+    assert m.compute() == pytest.approx(100.0 * 2 / 3)
+
+
+def test_topk_meter_top2_catches_runner_up():
+    m = TopKClassMeter(k=2)
+    outputs = np.array([[0.5, 0.4, 0.1],    # top2 {0,1}
+                        [0.1, 0.2, 0.7]])   # top2 {1,2}
+    targets = np.array([1, 0])              # in top2, not in top2
+    m.update(outputs, targets)
+    assert m.compute() == pytest.approx(50.0)
+
+
+def test_topk_meter_k_clamped_to_num_classes():
+    m = TopKClassMeter(k=10)
+    outputs = np.array([[0.6, 0.4], [0.3, 0.7]])
+    m.update(outputs, np.array([0, 0]))
+    # k > C degrades to "always correct"
+    assert m.compute() == pytest.approx(100.0)
+
+
+def test_topk_meter_data_set_round_trip_sums_like_workers():
+    # the harness reduces data() across workers by Sum, then set()s the
+    # reduced values — two local meters must equal one global meter.
+    a, b = TopKClassMeter(k=1), TopKClassMeter(k=1)
+    rng = np.random.RandomState(0)
+    oa, ob = rng.randn(16, 10), rng.randn(16, 10)
+    ta, tb = rng.randint(0, 10, 16), rng.randint(0, 10, 16)
+    a.update(oa, ta)
+    b.update(ob, tb)
+    reduced = {k: a.data()[k] + b.data()[k] for k in a.data()}
+
+    world = TopKClassMeter(k=1)
+    world.set(reduced)
+    ref = TopKClassMeter(k=1)
+    ref.update(np.concatenate([oa, ob]), np.concatenate([ta, tb]))
+    assert world.data() == ref.data()
+    assert world.compute() == pytest.approx(ref.compute())
+
+
+def test_topk_meter_update_counts_and_reset():
+    m = TopKClassMeter(k=1)
+    m.update_counts(7, 10)
+    m.update_counts(3, 10)
+    assert m.compute() == pytest.approx(50.0)
+    m.reset()
+    assert m.num_examples == 0
+    assert m.compute() == 0.0  # no division by zero on empty meter

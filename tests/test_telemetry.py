@@ -1,0 +1,411 @@
+"""Tests for dgc_tpu.telemetry: registry schema, in-graph taps, async sink,
+and the regression gate (ISSUE 2 tentpole acceptance):
+
+* telemetry=True must not perturb training — bitwise state equality vs
+  telemetry=False on the same inputs;
+* telemetry=False must compile away entirely — the lowered step contains no
+  telemetry ops;
+* the emitted stats must match the engine's static geometry (payload_elems,
+  wire_bytes, selected_frac ~ ratio);
+* regress exits 0 on self-compare and nonzero on a degraded run.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu.telemetry import registry, taps
+from dgc_tpu.telemetry.sink import TelemetrySink, read_run, summarize, to_csv
+from dgc_tpu.telemetry import regress
+
+
+# --------------------------------------------------------------------- #
+# registry                                                               #
+# --------------------------------------------------------------------- #
+
+def test_registry_names_unique_and_kinds_known():
+    names = registry.step_stat_names()
+    assert len(names) == len(set(names))
+    for s in registry.STEP_METRICS + registry.RUN_METRICS:
+        assert s.kind in ("scalar", "per_bucket")
+        assert s.better in ("", "lower", "higher")
+
+
+def test_validate_step_stats_catches_drift():
+    good = {n: 0.0 for n in registry.step_stat_names()}
+    registry.validate_step_stats(good)  # no raise
+    with pytest.raises(ValueError, match="missing"):
+        bad = dict(good)
+        del bad["grad_norm"]
+        registry.validate_step_stats(bad)
+    with pytest.raises(ValueError, match="extra"):
+        registry.validate_step_stats(dict(good, bogus=1.0))
+
+
+def test_step_out_specs_matches_stat_dict_structure():
+    specs = registry.step_out_specs(lambda: "P()")
+    assert set(specs) == set(registry.step_stat_names())
+
+
+def test_make_header_versioned():
+    h = registry.make_header({"engine": "test"})
+    assert h["schema"] == registry.SCHEMA
+    assert h["version"] == registry.SCHEMA_VERSION
+    assert h["static"] == {"engine": "test"}
+    assert {m["name"] for m in h["metrics"]} == set(
+        registry.step_stat_names())
+
+
+# --------------------------------------------------------------------- #
+# taps                                                                   #
+# --------------------------------------------------------------------- #
+
+def test_l2_basic_and_degenerate():
+    assert float(taps.l2(None)) == 0.0
+    assert float(taps.l2(jnp.zeros((0,)))) == 0.0
+    x = jnp.asarray([3.0, 4.0])
+    assert float(taps.l2(x)) == pytest.approx(5.0)
+    # bf16 input still reduces in f32
+    assert taps.l2(x.astype(jnp.bfloat16)).dtype == jnp.float32
+
+
+def test_bucket_payload_stats_counts_and_threshold():
+    S = 999
+    vals = jnp.asarray([0.5, -2.0, 0.0, 1.5])
+    gidx = jnp.asarray([3, 7, S, 12])
+    count, thr = taps.bucket_payload_stats(vals, gidx, S)
+    assert float(count) == 3.0
+    # min |value| over REAL slots only — the 0.0 sits in a sentinel slot
+    assert float(thr) == pytest.approx(0.5)
+
+
+def test_bucket_payload_stats_all_sentinel_is_zero_threshold():
+    S = 4
+    count, thr = taps.bucket_payload_stats(
+        jnp.zeros((3,)), jnp.full((3,), S), S)
+    assert float(count) == 0.0
+    assert float(thr) == 0.0  # not inf
+
+
+def test_empty_bucket_stats_shapes():
+    e = taps.empty_bucket_stats(3)
+    assert e["selected_frac"].shape == (3,)
+    assert e["threshold"].shape == (3,)
+    assert e["payload_elems"].shape == ()
+
+
+def test_assemble_step_stats_schema_and_dtype():
+    stats = taps.assemble_step_stats(
+        grad_norm=1.0, momentum_norm=2.0, residual_norm=3.0,
+        clip_delta=0.0, payload_elems=10, wire_bytes=80,
+        selected_frac=jnp.asarray([0.1]), threshold=jnp.asarray([0.5]))
+    assert set(stats) == set(registry.step_stat_names())
+    assert all(v.dtype == jnp.float32 for v in stats.values())
+
+
+def test_pmean_stats_single_collective_round_trip():
+    # per-device stats with distinct values; pmean over the axis must
+    # average every leaf and preserve shapes through the pack/unpack
+    n = 8
+    assert len(jax.devices()) >= n
+
+    def per_device(i):
+        stats = {
+            "a": i.astype(jnp.float32),
+            "b": jnp.stack([i, 2 * i]).astype(jnp.float32),
+        }
+        return taps.pmean_stats(stats, ("d",))
+
+    out = jax.pmap(per_device, axis_name="d")(jnp.arange(n))
+    mean = (n - 1) / 2
+    np.testing.assert_allclose(np.asarray(out["a"])[0], mean)
+    np.testing.assert_allclose(np.asarray(out["b"])[0], [mean, 2 * mean])
+    # replicated across devices
+    np.testing.assert_allclose(np.asarray(out["a"]),
+                               np.full((n,), mean))
+
+
+# --------------------------------------------------------------------- #
+# sink                                                                   #
+# --------------------------------------------------------------------- #
+
+def test_sink_write_read_round_trip(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    with TelemetrySink(p, static={"engine": "t"}) as sk:
+        sk.write(0, {"grad_norm": jnp.asarray(1.5),
+                     "selected_frac": jnp.asarray([0.1, 0.2])})
+        sk.write(1, {"grad_norm": jnp.asarray(2.5),
+                     "selected_frac": jnp.asarray([0.3, 0.4])})
+        sk.write_record({"event": "engine_rebuild", "epoch": 3})
+        sk.flush()
+    header, records = read_run(p)
+    assert header["static"] == {"engine": "t"}
+    steps = [r for r in records if "step" in r]
+    assert [r["step"] for r in steps] == [0, 1]
+    assert steps[0]["grad_norm"] == 1.5
+    assert steps[1]["selected_frac"] == [pytest.approx(0.3),
+                                         pytest.approx(0.4)]
+    events = [r for r in records if r.get("event") == "engine_rebuild"]
+    assert events and events[0]["epoch"] == 3
+
+
+def test_sink_directory_path_and_disabled(tmp_path):
+    d = str(tmp_path / "telem")
+    sk = TelemetrySink(d)
+    assert sk.path == os.path.join(d, "telemetry.jsonl")
+    sk.close()
+
+    off = TelemetrySink(str(tmp_path / "nope"), enabled=False)
+    off.write(0, {"grad_norm": 1.0})
+    off.flush()
+    off.close()
+    assert off.path is None
+    assert not (tmp_path / "nope").exists()
+
+
+def test_sink_rotation_rewrites_header(tmp_path):
+    p = str(tmp_path / "rot.jsonl")
+    with TelemetrySink(p, rotate_bytes=600) as sk:
+        for i in range(40):
+            sk.write(i, {"grad_norm": jnp.asarray(float(i))})
+        sk.flush()
+    rotated = sorted(f for f in os.listdir(tmp_path) if f.endswith(".jsonl"))
+    assert len(rotated) > 1, "rotation never triggered"
+    total = 0
+    for f in rotated:
+        header, records = read_run(str(tmp_path / f))  # every file parses
+        assert header["version"] == registry.SCHEMA_VERSION
+        total += len(records)
+    assert total == 40  # no record lost across rotation
+
+
+def test_read_run_rejects_foreign_and_wrong_version(tmp_path):
+    foreign = tmp_path / "foreign.jsonl"
+    foreign.write_text('{"hello": 1}\n')
+    with pytest.raises(ValueError, match="not a dgc-telemetry"):
+        read_run(str(foreign))
+    futur = tmp_path / "future.jsonl"
+    futur.write_text(json.dumps({"schema": registry.SCHEMA,
+                                 "version": registry.SCHEMA_VERSION + 1})
+                     + "\n")
+    with pytest.raises(ValueError, match="version"):
+        read_run(str(futur))
+
+
+def test_summarize_and_csv(tmp_path):
+    recs = [{"step": i, "grad_norm": float(i),
+             "selected_frac": [0.1, 0.2]} for i in range(5)]
+    s = summarize(recs)
+    assert s["grad_norm"]["median"] == 2.0
+    assert s["grad_norm"]["n"] == 5
+    # per-bucket lists summarize their sum
+    assert s["selected_frac"]["mean"] == pytest.approx(0.3)
+    assert "step" not in s
+
+    p = str(tmp_path / "c.jsonl")
+    with TelemetrySink(p) as sk:
+        for r in recs:
+            sk.write(r["step"], {"grad_norm": jnp.asarray(r["grad_norm"])})
+        sk.flush()
+    out = str(tmp_path / "c.csv")
+    to_csv(p, out)
+    lines = open(out).read().strip().splitlines()
+    assert len(lines) == 6  # header + 5 rows
+    assert "grad_norm" in lines[0]
+
+
+# --------------------------------------------------------------------- #
+# regress gate                                                           #
+# --------------------------------------------------------------------- #
+
+def _write_summary_run(path, **metrics):
+    with TelemetrySink(str(path)) as sk:
+        sk.write_record(dict({"event": "run_summary"}, **metrics))
+        sk.flush()
+    return str(path)
+
+
+def test_regress_self_compare_exits_zero(tmp_path, capsys):
+    run = _write_summary_run(tmp_path / "a.jsonl", step_time_ms=10.0,
+                             overhead_ms=1.0, wire_bytes=2264)
+    assert regress.main([run, run, "--tol", "0.10"]) == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_regress_degraded_run_exits_nonzero(tmp_path, capsys):
+    base = _write_summary_run(tmp_path / "b.jsonl", step_time_ms=10.0,
+                              overhead_ms=1.0, wire_bytes=2264)
+    worse = _write_summary_run(tmp_path / "w.jsonl", step_time_ms=12.0,
+                               overhead_ms=1.0, wire_bytes=2264)
+    rc = regress.main([base, worse, "--tol", "0.10"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "FAIL" in out
+
+
+def test_regress_improvement_always_passes(tmp_path):
+    base = _write_summary_run(tmp_path / "b.jsonl", step_time_ms=10.0)
+    better = _write_summary_run(tmp_path / "g.jsonl", step_time_ms=5.0)
+    assert regress.main([base, better, "--tol", "0.10"]) == 0
+
+
+def test_regress_reads_bench_wrapper_format(tmp_path):
+    # the driver's BENCH_r*.json wraps bench.py's JSON under "parsed"
+    wrapper = tmp_path / "BENCH.json"
+    wrapper.write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 0,
+         "parsed": {"metric": "exchange_ms", "value": 3.0,
+                    "overhead_ms": 1.0, "wire_bytes": 2264}}))
+    run = _write_summary_run(tmp_path / "r.jsonl", exchange_ms=3.1,
+                             overhead_ms=1.05, wire_bytes=2264)
+    assert regress.main([str(wrapper), str(run), "--tol", "0.10"]) == 0
+    bad = _write_summary_run(tmp_path / "bad.jsonl", exchange_ms=4.0,
+                             overhead_ms=1.0, wire_bytes=2264)
+    assert regress.main([str(wrapper), str(bad), "--tol", "0.10"]) == 1
+
+
+def test_regress_usage_error_exit_two(tmp_path):
+    empty = tmp_path / "garbage.txt"
+    empty.write_text("not json at all\n")
+    assert regress.main([str(empty), str(empty)]) == 2
+
+
+def test_compare_direction_handling():
+    rows = regress.compare({"step_time_ms": 10.0}, {"step_time_ms": 10.5},
+                           tol=0.10)
+    assert rows[0]["regressed"] is False      # +5% within 10%
+    rows = regress.compare({"step_time_ms": 10.0}, {"step_time_ms": 11.5},
+                           tol=0.10)
+    assert rows[0]["regressed"] is True       # +15% over 10%
+    # zero baseline compares absolutely, no division blowup
+    rows = regress.compare({"overhead_ms": 0.0}, {"overhead_ms": 0.05},
+                           tol=0.10)
+    assert rows[0]["regressed"] is False
+    rows = regress.compare({"overhead_ms": 0.0}, {"overhead_ms": 0.5},
+                           tol=0.10)
+    assert rows[0]["regressed"] is True
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: taps inside the real flat train step                       #
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def flat_step_pair(mesh8):
+    """(state, step_telemetry, step_plain, setup, inputs) on a tiny model
+    over the 8 fake devices — built once for the whole module."""
+    from flax import linen as nn
+    from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer
+    from dgc_tpu import dgc_sgd
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+    from dgc_tpu.utils.pytree import named_flatten
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x.mean(axis=(1, 2)))
+
+    model = M()
+    v = dict(model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3))))
+
+    def apply_fn(variables, x, train=True, mutable=None, rngs=None):
+        if mutable:
+            return model.apply(variables, x, train=train, mutable=mutable,
+                               rngs=rngs)
+        return model.apply(variables, x, train=train)
+
+    W = 8
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9))
+    named, _ = named_flatten(v["params"])
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    setup = make_flat_setup(v, dist)
+    state = shard_state(make_flat_state(v, dist, setup, W), mesh8,
+                        dist_opt=dist)
+    step_t = build_train_step(apply_fn, dist, mesh8, donate=False,
+                              flat=setup, telemetry=True)
+    step_p = build_train_step(apply_fn, dist, mesh8, donate=False,
+                              flat=setup, telemetry=False)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(W * 4, 16, 16, 3), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, W * 4), jnp.int32)
+    return state, step_t, step_p, setup, (images, labels)
+
+
+def test_step_telemetry_stats_match_engine_geometry(flat_step_pair):
+    state, step_t, _, setup, (images, labels) = flat_step_pair
+    _, m = step_t(state, images, labels, jax.random.PRNGKey(1))
+    t = {k: np.asarray(v) for k, v in m["telemetry"].items()}
+    assert set(t) == set(registry.step_stat_names())
+    eng = setup.engine
+    assert t["payload_elems"] == pytest.approx(eng.payload_size)
+    assert t["wire_bytes"] == pytest.approx(eng.wire_bytes_per_worker())
+    assert t["grad_norm"] > 0
+    assert t["momentum_norm"] > 0
+    assert t["selected_frac"].shape == (len(eng.buckets),)
+    # warm-up-free run at ratio 0.05: selection tracks the ratio closely
+    np.testing.assert_allclose(t["selected_frac"], 0.05, atol=0.02)
+    assert (t["threshold"] >= 0).all()
+
+
+def test_step_telemetry_does_not_perturb_training(flat_step_pair):
+    state, step_t, step_p, _, (images, labels) = flat_step_pair
+    s1, m1 = step_t(state, images, labels, jax.random.PRNGKey(1))
+    s2, m2 = step_p(state, images, labels, jax.random.PRNGKey(1))
+    assert float(m1["loss"]) == float(m2["loss"])
+    for (pa, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(s1),
+                               jax.tree_util.tree_leaves_with_path(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(pa))
+
+
+def test_step_telemetry_off_compiles_away(flat_step_pair):
+    state, _, step_p, _, (images, labels) = flat_step_pair
+    txt = jax.jit(step_p).lower(state, images, labels,
+                                jax.random.PRNGKey(1)).as_text()
+    assert "telemetry" not in txt
+
+
+def test_step_telemetry_residual_energy_identity(flat_step_pair):
+    # deferred masking: ||residual||^2 + sum(transmitted^2) == ||vc||^2,
+    # so residual_norm must sit strictly between 0 and grad-scale values
+    state, step_t, _, _, (images, labels) = flat_step_pair
+    _, m = step_t(state, images, labels, jax.random.PRNGKey(1))
+    t = {k: float(np.asarray(v)) for k, v in m["telemetry"].items()
+         if np.asarray(v).ndim == 0}
+    assert 0 <= t["residual_norm"] <= t["momentum_norm"] + t["grad_norm"]
+
+
+@pytest.mark.fast
+def test_telemetry_smoke_step_sink_regress(flat_step_pair, tmp_path):
+    """The scripts/t1.sh telemetry smoke (-m fast): one telemetry step
+    through the sink, then regress must pass on self-compare."""
+    state, step_t, _, setup, (images, labels) = flat_step_pair
+    _, m = step_t(state, images, labels, jax.random.PRNGKey(1))
+    p = str(tmp_path / "smoke.jsonl")
+    with TelemetrySink(p, static=setup.engine.telemetry_static()) as sk:
+        sk.write(0, m["telemetry"])
+        sk.write_record({
+            "event": "run_summary",
+            "wire_bytes": setup.engine.wire_bytes_per_worker(),
+            "payload_elems": setup.engine.payload_size})
+        sk.flush()
+    assert regress.main([p, p, "--tol", "0.10"]) == 0
+
+
+def test_dense_engine_telemetry_has_empty_buckets(mesh8):
+    # the dense baseline path still emits the schema (zeros / empty
+    # per-bucket arrays) so sinks and specs never branch
+    from dgc_tpu.compression.flat import FlatDenseExchange
+    e = taps.empty_bucket_stats(0)
+    assert e["selected_frac"].shape == (0,)
+    assert hasattr(FlatDenseExchange, "exchange")

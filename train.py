@@ -296,6 +296,24 @@ def main():
 
     writer = MetricWriter(configs.train.save_path)
 
+    # compression-health telemetry (configs/telemetry.py, docs/TELEMETRY.md):
+    # per-step stats ride the jitted step's aux outputs; the async sink
+    # drains completed device buffers on its own thread — the train loop
+    # never adds a host sync. Coordinator-only files, like MetricWriter.
+    tcfg = configs.train.get("telemetry", None)
+    telemetry_on = bool(tcfg and tcfg.get("enabled", False))
+    sink = None
+    if telemetry_on:
+        from dgc_tpu.telemetry.sink import TelemetrySink
+        telem_every = int(tcfg.get("every", 1) or 1)
+        sink = TelemetrySink(
+            os.path.join(configs.train.save_path, "telemetry"),
+            static=dict(flat_setup.engine.telemetry_static(),
+                        world=world, num_local_workers=num_local),
+            rotate_bytes=int(tcfg.get("rotate_mb", 64)) << 20,
+            enabled=jax.process_index() == 0)
+        printr(f"[telemetry] -> {sink.path or '(non-coordinator)'}")
+
     ############
     # Training #
     ############
@@ -316,7 +334,14 @@ def main():
                                        num_batches_per_step=nbps,
                                        use_dropout=use_dropout,
                                        flat=flat_setup,
-                                       model_dtype=_narrow_model_dtype(model))
+                                       model_dtype=_narrow_model_dtype(model),
+                                       telemetry=telemetry_on)
+            if sink is not None:
+                # engine geometry changes with the warm-up ratio: record
+                # it so readers can re-anchor the per-bucket columns
+                sink.write_record(dict(
+                    flat_setup.engine.telemetry_static(),
+                    event="engine_rebuild", epoch=epoch))
 
         ds = dataset["train"]
         t0 = time.time()
@@ -353,6 +378,10 @@ def main():
                         jax.profiler.stop_trace()
                 seen += 1
                 num_inputs += global_batch
+                if sink is not None and bidx % telem_every == 0:
+                    # device arrays enqueued as-is: the sink's drain
+                    # thread does the (blocking) device->host transfer
+                    sink.write(num_inputs, metrics["telemetry"])
                 logged = bidx % 50 == 0
                 if logged:
                     writer.add_scalar("loss/train", float(metrics["loss"]),
@@ -387,6 +416,8 @@ def main():
         path = ckpt.save(epoch, state, meters, best=best, topology=topology)
         printr(f"[save_path] = {path}")
 
+    if sink is not None:
+        sink.close()
     writer.close()
 
 
