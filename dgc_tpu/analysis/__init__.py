@@ -1,0 +1,41 @@
+"""dgclint — TPU-hazard static analysis + compiled-program contracts.
+
+Two layers, one CLI gate (``python -m dgc_tpu.analysis``):
+
+* **Layer 1 — AST lints** (:mod:`~dgc_tpu.analysis.astlint`,
+  :mod:`~dgc_tpu.analysis.rules`): a visitor-based linter with DGC-specific
+  rules over the package source — host-sync calls reachable from jitted
+  scope, Python branches on tracer values, float64 drift, host entropy in
+  traced code, donation/static-argnums hygiene. Pure AST work: no jax
+  import, runs in milliseconds (``scripts/lint.sh``).
+* **Layer 2 — program contracts** (:mod:`~dgc_tpu.analysis.contracts`,
+  :mod:`~dgc_tpu.analysis.hlo`, :mod:`~dgc_tpu.analysis.suite`): a
+  declarative API over *lowered and compiled* programs — collective
+  counts, donation aliases, forbidden ops, byte-identity, recompile
+  guards — plus the repo's standing contract suite pinning the paper's
+  compiled-step guarantees (one sparse exchange, telemetry compiles away,
+  donated buffers alias, no opt-barriers in the fused-apply epilogue).
+
+Audited exceptions live in ``analysis/allowlist.toml`` (one-line
+justification each); see docs/ANALYSIS.md for the rule catalog and how to
+add a rule or contract.
+"""
+
+from dgc_tpu.analysis.rules import RULES, Allowlist, Finding  # noqa: F401
+
+__all__ = ["RULES", "Allowlist", "Finding", "lint_paths", "Contract",
+           "ContractViolation", "RecompileGuard"]
+
+
+def lint_paths(*args, **kwargs):
+    """Lazy alias for :func:`dgc_tpu.analysis.astlint.lint_paths`."""
+    from dgc_tpu.analysis.astlint import lint_paths as _lint
+    return _lint(*args, **kwargs)
+
+
+def __getattr__(name):
+    # Contract machinery imports jax — keep the AST layer import-light
+    if name in ("Contract", "ContractViolation", "RecompileGuard"):
+        from dgc_tpu.analysis import contracts
+        return getattr(contracts, name)
+    raise AttributeError(name)

@@ -1,0 +1,97 @@
+"""CLI gate: ``python -m dgc_tpu.analysis [paths...] [options]``.
+
+Modes
+-----
+default / ``--lint``   AST lints only (milliseconds, no jax import).
+``--contracts``        compiled-program contract suite only.
+``--gate``             both — the CI entry wired into scripts/t1.sh.
+
+Exit codes: 0 clean, 1 violations (un-allowlisted lint findings or any
+failed contract), 2 usage/internal error.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_devices():
+    # the contract suite needs the 8-fake-device CPU platform; both knobs
+    # must be set before jax initializes (mirrors tests/conftest.py)
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    from dgc_tpu.analysis.astlint import DEFAULT_ROOTS, lint_paths
+    from dgc_tpu.analysis.rules import load_allowlist
+
+    ap = argparse.ArgumentParser(
+        prog="python -m dgc_tpu.analysis",
+        description="dgclint: TPU-hazard linter + program contract gate")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_ROOTS})")
+    ap.add_argument("--lint", action="store_true",
+                    help="AST lints only (the default mode)")
+    ap.add_argument("--contracts", action="store_true",
+                    help="compiled-program contract suite only")
+    ap.add_argument("--gate", action="store_true",
+                    help="lints + contracts (CI mode)")
+    ap.add_argument("--allowlist", default=None, metavar="TOML",
+                    help="override analysis/allowlist.toml")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable lint findings")
+    ap.add_argument("--show-allowed", action="store_true",
+                    help="also print allowlisted findings")
+    ap.add_argument("--root", default=None,
+                    help="repo root to lint relative to (default: cwd)")
+    args = ap.parse_args(argv)
+
+    do_contracts = args.contracts or args.gate
+    do_lint = args.lint or args.gate or not args.contracts
+    rc = 0
+
+    if do_lint:
+        try:
+            allowlist = load_allowlist(args.allowlist)
+        except ValueError as e:
+            print(f"dgclint: bad allowlist: {e}", file=sys.stderr)
+            return 2
+        findings = lint_paths(args.paths or DEFAULT_ROOTS,
+                              allowlist=allowlist, root=args.root)
+        bad = [f for f in findings if not f.allowed]
+        if args.as_json:
+            print(json.dumps([vars(f) for f in findings], indent=2))
+        else:
+            shown = findings if args.show_allowed else bad
+            for f in shown:
+                print(f.format())
+            n_allowed = sum(f.allowed for f in findings)
+            print(f"dgclint: {len(bad)} violation(s), "
+                  f"{n_allowed} allowlisted")
+        if bad:
+            rc = 1
+
+    if do_contracts:
+        _ensure_devices()
+        from dgc_tpu.analysis.suite import run_contract_suite
+        results = run_contract_suite(log=lambda s: print(f"dgclint: {s}"),
+                                     root=args.root)
+        failed = [(n, v) for n, v in results if v]
+        for name, violations in failed:
+            print(f"CONTRACT FAIL {name}")
+            for v in violations:
+                print(f"  - {v}")
+        print(f"dgclint: contracts {len(results) - len(failed)}/"
+              f"{len(results)} ok")
+        if failed:
+            rc = 1
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

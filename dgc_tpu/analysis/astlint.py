@@ -1,0 +1,692 @@
+"""Visitor-based AST linter for TPU hazards (dgclint layer 1).
+
+Pure ``ast`` work — no jax import, so the whole tree lints in
+milliseconds (``scripts/lint.sh``). Two analyses feed the rules:
+
+**Traced-scope inference.** A function is *traced* (its body runs under
+``jax.jit`` tracing) if it is decorated with jit/pjit/custom_vjp/..., or
+passed to a tracing combinator (``shard_map``, ``lax.scan``,
+``value_and_grad``, ...), or reachable from a traced function through the
+module-set call graph (bare-name calls, method-name calls, and
+function references passed as arguments — e.g. ``jax.tree.map(place,
+...)``). Name-based matching over-approximates on purpose: it is cheap,
+never misses a real hazard, and the rare same-name host function that
+gets pulled in is exactly what the audited allowlist is for.
+
+**Taint.** Within a traced function, parameters (minus ``self``/``cls``
+and parameters annotated ``int``/``bool``/``str``/``float``) are
+tracer-valued; taint propagates through assignments, arithmetic, and
+calls. Shape/dtype/ndim attribute reads, ``is``/``is not`` comparisons,
+and ``isinstance``/``len`` are *static at trace time* and neutralize
+taint — so ``if x is None`` and ``if g.shape[0] == n`` stay clean while
+``if jnp.any(x)`` and ``float(loss)`` fire.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dgc_tpu.analysis.rules import (Allowlist, Finding, RULES_BY_ID,
+                                    load_allowlist)
+
+__all__ = ["lint_paths", "lint_source", "collect_files", "DEFAULT_ROOTS"]
+
+#: default lint roots, relative to the repo root (scripts/ and bench.py are
+#: benchmark/driver code whose deliberate block-and-measure syncs are the
+#: point — lint them explicitly if wanted)
+DEFAULT_ROOTS = ("dgc_tpu", "train.py")
+
+#: calling one of these with a function argument traces that function
+_TRACING_CALLS = {
+    "jit", "pjit", "pmap", "vmap", "shard_map", "scan", "while_loop",
+    "fori_loop", "cond", "switch", "grad", "value_and_grad", "custom_vjp",
+    "custom_jvp", "defvjp", "defjvp", "checkpoint", "remat",
+    "associative_scan",
+}
+
+#: decorators that make the decorated function traced
+_TRACING_DECORATORS = {"jit", "pjit", "custom_vjp", "custom_jvp",
+                       "checkpoint", "remat"}
+
+#: attribute reads that are static at trace time (abstract-value metadata)
+_NEUTRAL_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
+                  "aval", "weak_type"}
+
+#: jax.* / jnp.* calls returning host values (static at trace time)
+_NEUTRAL_JAX_CALLS = {"devices", "local_devices", "device_count",
+                      "local_device_count", "process_count",
+                      "process_index", "axis_size", "default_backend",
+                      "issubdtype", "isdtype", "finfo", "iinfo",
+                      "result_type", "promote_types", "canonicalize_dtype"}
+
+#: builtins whose result is static even on tracer args
+_NEUTRAL_BUILTINS = {"len", "isinstance", "hasattr", "getattr", "type",
+                     "repr", "str", "id", "callable", "set", "frozenset"}
+
+#: module roots whose calls produce tracer values inside traced scope
+_ARRAY_MODULES = {"jnp", "jax", "lax", "pl", "pltpu"}
+
+_STATIC_ANNOTATIONS = {"int", "bool", "str", "float", "bytes"}
+
+_STEP_CALL_RE = re.compile(r"(^|_)(step|eval)(_fn)?$")
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> 'c'; `name` -> 'name'; else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> 'a'; `name` -> 'name'; else None."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _dotted_parts(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts[::-1]
+
+
+def _is_static_annotation(ann: Optional[ast.AST]) -> bool:
+    """int/bool/str/float (optionally Optional[...]-wrapped) params hold
+    host values, not tracers."""
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Name):
+        return ann.id in _STATIC_ANNOTATIONS
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip() in _STATIC_ANNOTATIONS
+    if isinstance(ann, ast.Subscript):
+        if _terminal_name(ann.value) in ("Optional", "Union"):
+            inner = ann.slice
+            elts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+            return all(
+                _is_static_annotation(e)
+                or (isinstance(e, ast.Constant) and e.value is None)
+                for e in elts)
+    return False
+
+
+class _FuncInfo:
+    __slots__ = ("key", "name", "node", "path", "calls", "traced")
+
+    def __init__(self, key, name, node, path):
+        self.key = key
+        self.name = name
+        self.node = node
+        self.path = path
+        self.calls: Set[str] = set()   # names this function invokes/passes
+        self.traced = False
+
+
+class _Module:
+    def __init__(self, path: str, tree: ast.Module, lines: List[str]):
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+        self.functions: List[_FuncInfo] = []
+
+
+# --------------------------------------------------------------------- #
+# pass 1: function collection + traced-scope inference                   #
+# --------------------------------------------------------------------- #
+
+def _collect_functions(mod: _Module) -> None:
+    path = mod.path
+
+    def visit(node, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{path}::{qual}{child.name}"
+                mod.functions.append(_FuncInfo(key, child.name, child, path))
+                visit(child, f"{qual}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{qual}{child.name}.")
+            else:
+                visit(child, qual)
+
+    visit(mod.tree, "")
+
+
+def _decorator_traced(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        for sub in ast.walk(dec):
+            name = _terminal_name(sub)
+            if name in _TRACING_DECORATORS:
+                return True
+    return False
+
+
+def _function_edges(info: _FuncInfo, own_names: Set[str]) -> None:
+    """Names ``info`` calls or passes as function references (excluding
+    nested defs, which are their own nodes)."""
+    nested = {id(n) for child in ast.iter_child_nodes(info.node)
+              for n in ast.walk(child)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n is not info.node}
+
+    for sub in ast.walk(info.node):
+        if id(sub) in nested and sub is not info.node:
+            continue
+        if isinstance(sub, ast.Call):
+            name = _terminal_name(sub.func)
+            if name:
+                info.calls.add(name)
+            for arg in list(sub.args) + [k.value for k in sub.keywords]:
+                ref = _terminal_name(arg)
+                if ref and ref in own_names:
+                    info.calls.add(ref)
+
+
+def _seed_traced(modules: Sequence[_Module]) -> None:
+    by_name: Dict[str, List[_FuncInfo]] = {}
+    for mod in modules:
+        for f in mod.functions:
+            by_name.setdefault(f.name, []).append(f)
+
+    # seeds: tracing decorators + function refs passed to tracing calls
+    for mod in modules:
+        for f in mod.functions:
+            if _decorator_traced(f.node):
+                f.traced = True
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if name not in _TRACING_CALLS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                ref = _terminal_name(arg)
+                for g in by_name.get(ref, ()):
+                    g.traced = True
+
+    # edges + fixpoint propagation (call by name => callee traced)
+    own_names = set(by_name)
+    for mod in modules:
+        for f in mod.functions:
+            _function_edges(f, own_names)
+    changed = True
+    while changed:
+        changed = False
+        for mod in modules:
+            for f in mod.functions:
+                if not f.traced:
+                    continue
+                for callee in f.calls:
+                    for g in by_name.get(callee, ()):
+                        if not g.traced:
+                            g.traced = True
+                            changed = True
+
+
+# --------------------------------------------------------------------- #
+# taint                                                                  #
+# --------------------------------------------------------------------- #
+
+class _Taint:
+    """Sequential forward taint over one function body (no CFG: joins are
+    union-by-walk-order, which over-approximates — fine for a linter)."""
+
+    def __init__(self, fn: ast.AST):
+        self.names: Set[str] = set()
+        args = fn.args
+        # params with a bool/str literal default are config flags, static
+        # at trace time (e.g. ``nesterov=False``)
+        static_by_default: Set[str] = set()
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value,
+                                                          (bool, str)):
+                static_by_default.add(a.arg)
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value,
+                                                          (bool, str)):
+                static_by_default.add(a.arg)
+        for a in (pos + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.arg in ("self", "cls") or a.arg in static_by_default:
+                continue
+            if _is_static_annotation(a.annotation):
+                continue
+            self.names.add(a.arg)
+
+    # -- expression taint ------------------------------------------------
+    def expr(self, e: ast.AST) -> bool:
+        if e is None or isinstance(e, (ast.Constant, ast.Lambda)):
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.names
+        if isinstance(e, ast.Attribute):
+            if e.attr in _NEUTRAL_ATTRS:
+                return False
+            return self.expr(e.value)
+        if isinstance(e, ast.Call):
+            name = _terminal_name(e.func)
+            if name in _NEUTRAL_BUILTINS or name in _NEUTRAL_JAX_CALLS:
+                return False
+            root = _root_name(e.func)
+            if root in _ARRAY_MODULES and root != "jax":
+                return True
+            if root == "jax" and name not in _NEUTRAL_JAX_CALLS:
+                return True
+            if self.expr(e.func):
+                return True
+            return any(self.expr(a) for a in e.args) or any(
+                self.expr(k.value) for k in e.keywords)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False
+            return self.expr(e.left) or any(self.expr(c)
+                                            for c in e.comparators)
+        if isinstance(e, ast.BoolOp):
+            return any(self.expr(v) for v in e.values)
+        if isinstance(e, ast.BinOp):
+            return self.expr(e.left) or self.expr(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr(e.operand)
+        if isinstance(e, ast.Subscript):
+            return self.expr(e.value) or self.expr(e.slice)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(v) for v in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(self.expr(v) for v in e.values if v is not None)
+        if isinstance(e, ast.IfExp):
+            return (self.expr(e.test) or self.expr(e.body)
+                    or self.expr(e.orelse))
+        if isinstance(e, ast.Starred):
+            return self.expr(e.value)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return any(self.expr(g.iter) for g in e.generators) or \
+                self.expr(e.elt)
+        if isinstance(e, ast.DictComp):
+            return any(self.expr(g.iter) for g in e.generators) or \
+                self.expr(e.key) or self.expr(e.value)
+        if isinstance(e, ast.JoinedStr):
+            return any(self.expr(v) for v in e.values)
+        if isinstance(e, ast.FormattedValue):
+            return self.expr(e.value)
+        if isinstance(e, ast.Slice):
+            return any(self.expr(x) for x in (e.lower, e.upper, e.step)
+                       if x is not None)
+        if isinstance(e, ast.NamedExpr):
+            t = self.expr(e.value)
+            if t and isinstance(e.target, ast.Name):
+                self.names.add(e.target.id)
+            return t
+        return False
+
+    # -- statement walk --------------------------------------------------
+    def mark_target(self, t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self.mark_target(e)
+        elif isinstance(t, ast.Starred):
+            self.mark_target(t.value)
+
+    def feed(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and self.expr(stmt.value):
+            for t in stmt.targets:
+                self.mark_target(t)
+        elif isinstance(stmt, ast.AugAssign) and (
+                self.expr(stmt.value) or self.expr(stmt.target)):
+            self.mark_target(stmt.target)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and self.expr(stmt.value):
+            self.mark_target(stmt.target)
+        elif isinstance(stmt, ast.For) and self.expr(stmt.iter):
+            self.mark_target(stmt.target)
+
+
+# --------------------------------------------------------------------- #
+# pass 2: rule checks                                                    #
+# --------------------------------------------------------------------- #
+
+class _FileLinter:
+    def __init__(self, mod: _Module, findings: List[Finding]):
+        self.mod = mod
+        self.findings = findings
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        snippet = (self.mod.lines[line - 1].strip()
+                   if 0 < line <= len(self.mod.lines) else "")
+        if Allowlist.inline_waiver(snippet, rule):
+            return
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.path, line=line,
+            col=getattr(node, "col_offset", 0), snippet=snippet,
+            message=message))
+
+    # -- whole-module rules (taint-free) --------------------------------
+    def lint_module_wide(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr in (
+                    "float64", "double") and _root_name(node) in (
+                    "np", "numpy", "jnp"):
+                self.emit("f64-dtype", node,
+                          f"{_root_name(node)}.{node.attr} in a pipeline "
+                          "whose contract is f32 end-to-end")
+            elif isinstance(node, ast.Call):
+                self._check_astype_f64(node)
+                self._check_static_argnums(node)
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_missing_donate(node)
+
+    def _check_astype_f64(self, call: ast.Call) -> None:
+        name = _terminal_name(call.func)
+        f64_arg = any(
+            (isinstance(a, ast.Name) and a.id == "float64")
+            or (isinstance(a, ast.Constant) and a.value == "float64")
+            or (isinstance(a, ast.Attribute) and a.attr in ("float64",
+                                                            "double"))
+            for a in call.args)
+        kw_f64 = any(
+            k.arg == "dtype" and (
+                (isinstance(k.value, ast.Constant)
+                 and k.value.value == "float64")
+                or (isinstance(k.value, ast.Name)
+                    and k.value.id == "float"))
+            for k in call.keywords)
+        if (name == "astype" and (f64_arg or any(
+                isinstance(a, ast.Name) and a.id == "float"
+                for a in call.args))) or kw_f64 or (
+                name not in ("astype",) and f64_arg
+                and name in ("zeros", "ones", "full", "empty", "asarray",
+                             "array", "arange")):
+            self.emit("f64-dtype", call,
+                      "float64 dtype literal (astype(float) promotes to "
+                      "f64 under x64 mode; pin f32/bf16 explicitly)")
+
+    def _check_static_argnums(self, call: ast.Call) -> None:
+        involves_jit = any(
+            _terminal_name(sub) in ("jit", "pjit")
+            for sub in ast.walk(call.func)) or any(
+            _terminal_name(a) in ("jit", "pjit") for a in call.args)
+        if not involves_jit:
+            return
+        for k in call.keywords:
+            if k.arg not in ("static_argnums", "static_argnames"):
+                continue
+            v = k.value
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                self.emit("static-argnums", k.value,
+                          f"{k.arg} is an unhashable {type(v).__name__.lower()}"
+                          " literal — use a tuple")
+            elif isinstance(v, ast.Tuple) and any(
+                    isinstance(e, (ast.List, ast.Dict, ast.Set))
+                    for e in v.elts):
+                self.emit("static-argnums", k.value,
+                          f"{k.arg} tuple contains an unhashable element")
+
+    def _check_missing_donate(self, fn: ast.AST) -> None:
+        jit_dec = None
+        for dec in fn.decorator_list:
+            if any(_terminal_name(sub) in ("jit", "pjit")
+                   for sub in ast.walk(dec)):
+                jit_dec = dec
+                break
+        if jit_dec is None:
+            return
+        kws = (jit_dec.keywords if isinstance(jit_dec, ast.Call) else [])
+        if any(k.arg in ("donate_argnums", "donate_argnames") for k in kws):
+            return
+        params = [a.arg for a in fn.args.args if a.arg not in ("self",
+                                                               "cls")]
+        if params and params[0] in ("state", "train_state", "opt_state",
+                                    "carry"):
+            self.emit("missing-donate", fn,
+                      f"jitted {fn.name}({params[0]}, ...) threads state "
+                      "without donate_argnums — the dead input buffer "
+                      "doubles peak HBM")
+
+    # -- traced-function rules ------------------------------------------
+    def lint_traced_function(self, fn: ast.AST) -> None:
+        taint = _Taint(fn)
+        nested = [n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not fn]
+        skip = {id(x) for n in nested for x in ast.walk(n)}
+
+        for node in ast.walk(fn):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.stmt):
+                taint.feed(node)
+            if isinstance(node, (ast.If, ast.While)):
+                if taint.expr(node.test):
+                    self.emit("tracer-branch", node,
+                              "Python branch on a tracer-valued test in "
+                              "jitted scope — use jnp.where/lax.cond or "
+                              "hoist the condition to static config")
+            elif isinstance(node, ast.Assert):
+                if taint.expr(node.test):
+                    self.emit("tracer-branch", node,
+                              "assert on a tracer value in jitted scope — "
+                              "use checkify or a static precondition")
+            elif isinstance(node, ast.IfExp):
+                if taint.expr(node.test):
+                    self.emit("tracer-branch", node,
+                              "conditional expression on a tracer test in "
+                              "jitted scope — use jnp.where")
+            elif isinstance(node, ast.Call):
+                self._check_traced_call(node, taint)
+
+    def _check_traced_call(self, call: ast.Call, taint: _Taint) -> None:
+        func = call.func
+        name = _terminal_name(func)
+        root = _root_name(func)
+        arg0 = call.args[0] if call.args else None
+
+        if isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                      "bool"):
+            if arg0 is not None and taint.expr(arg0):
+                self.emit("host-sync", call,
+                          f"{func.id}() on a tracer forces a device "
+                          "round-trip (or a ConcretizationTypeError) "
+                          "inside jitted scope")
+            return
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("item", "tolist") and taint.expr(func.value):
+                self.emit("host-sync", call,
+                          f".{func.attr}() on a tracer inside jitted "
+                          "scope is a host sync")
+                return
+            if func.attr in ("asarray", "array") and root in (
+                    "np", "numpy") and arg0 is not None \
+                    and taint.expr(arg0):
+                self.emit("host-sync", call,
+                          "np.%s on a tracer materializes to host inside "
+                          "jitted scope (use jnp)" % func.attr)
+                return
+        if name in ("device_get", "block_until_ready") and root in (
+                "jax", None) or (isinstance(func, ast.Attribute)
+                                 and func.attr == "block_until_ready"
+                                 and taint.expr(func.value)):
+            self.emit("host-sync", call,
+                      f"{name or func.attr} inside jitted scope is always "
+                      "a host sync")
+            return
+        if isinstance(func, ast.Name) and func.id == "print":
+            self.emit("host-sync", call,
+                      "print() in jitted scope runs at trace time only "
+                      "(or syncs under callbacks) — use jax.debug.print")
+            return
+
+        # host entropy
+        parts = _dotted_parts(func)
+        if parts[:1] == ["time"] and name in ("time", "perf_counter",
+                                              "monotonic", "process_time",
+                                              "time_ns"):
+            self.emit("host-entropy", call,
+                      "host wall-clock in traced code freezes into the "
+                      "compiled program — thread times from the driver")
+        elif root in ("np", "numpy") and "random" in parts:
+            self.emit("host-entropy", call,
+                      "np.random in traced code freezes one draw into "
+                      "the program — use jax.random with a threaded key")
+        elif root == "random" and name in ("random", "randint", "uniform",
+                                           "choice", "shuffle", "gauss",
+                                           "sample", "randrange"):
+            self.emit("host-entropy", call,
+                      "stdlib random in traced code freezes one draw "
+                      "into the program — use jax.random")
+
+    # -- host driver-loop rule ------------------------------------------
+    def lint_host_loops(self, host_fns: List[ast.AST]) -> None:
+        bodies = [(fn, list(ast.walk(fn))) for fn in host_fns]
+        for fn, nodes in bodies:
+            nested = {id(x)
+                      for n in nodes
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) and n is not fn
+                      for x in ast.walk(n)}
+            for node in nodes:
+                if id(node) in nested or not isinstance(
+                        node, (ast.For, ast.While, ast.AsyncFor)):
+                    continue
+                self._check_driver_loop(node)
+
+    def _check_driver_loop(self, loop: ast.AST) -> None:
+        body_nodes = [x for stmt in loop.body for x in ast.walk(stmt)]
+        calls_step = any(
+            isinstance(n, ast.Call)
+            and _STEP_CALL_RE.search(_terminal_name(n.func) or "")
+            for n in body_nodes)
+        if not calls_step:
+            return
+        # nodes inside nested loops belong to *those* loops' iteration
+        # cadence — they are checked when the nested loop is visited
+        inner = {id(x)
+                 for n in body_nodes
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))
+                 for x in ast.walk(n) if x is not n}
+        body_nodes = [n for n in body_nodes if id(n) not in inner]
+        for n in body_nodes:
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            name = _terminal_name(f)
+            if isinstance(f, ast.Name) and f.id in ("float", "int") \
+                    and n.args and not isinstance(n.args[0], ast.Constant):
+                self.emit("sync-in-loop", n,
+                          f"{f.id}() on a step output inside the driver "
+                          "loop blocks the dispatch pipeline every "
+                          "iteration — collect device values and convert "
+                          "after the loop")
+            elif isinstance(f, ast.Attribute) and f.attr == "item":
+                self.emit("sync-in-loop", n,
+                          ".item() inside the driver loop blocks the "
+                          "dispatch pipeline every iteration")
+            elif name == "device_get" and _root_name(f) == "jax":
+                self.emit("sync-in-loop", n,
+                          "jax.device_get inside the driver loop blocks "
+                          "the dispatch pipeline every iteration")
+
+
+# --------------------------------------------------------------------- #
+# entry points                                                           #
+# --------------------------------------------------------------------- #
+
+def collect_files(paths: Sequence[str], root: Optional[str] = None
+                  ) -> List[str]:
+    """Expand files/directories into a sorted .py file list (paths
+    returned relative to ``root`` when given)."""
+    out = []
+    for p in paths:
+        full = os.path.join(root, p) if root and not os.path.isabs(p) else p
+        if os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if not d.startswith((".", "__pycache__"))]
+                for f in filenames:
+                    if f.endswith(".py"):
+                        out.append(os.path.join(dirpath, f))
+        elif full.endswith(".py"):
+            out.append(full)
+    if root:
+        out = [os.path.relpath(p, root) for p in out]
+    return sorted(set(p.replace(os.sep, "/") for p in out))
+
+
+def lint_source(source: str, path: str = "<string>",
+                allowlist: Optional[Allowlist] = None) -> List[Finding]:
+    """Lint one source string (fixture tests use this)."""
+    return _lint_modules([(path, source)], allowlist or Allowlist())
+
+
+def lint_paths(paths: Sequence[str] = DEFAULT_ROOTS,
+               allowlist: Optional[Allowlist] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint files/directories. Returns ALL findings; allowlisted ones are
+    flagged ``allowed=True`` (the CLI gate fails only on un-allowed)."""
+    root = root or os.getcwd()
+    if allowlist is None:
+        allowlist = load_allowlist()
+    files = collect_files(paths, root=root)
+    sources = []
+    for rel in files:
+        with open(os.path.join(root, rel), "r", encoding="utf-8") as f:
+            sources.append((rel, f.read()))
+    return _lint_modules(sources, allowlist)
+
+
+def _lint_modules(sources: Sequence[Tuple[str, str]],
+                  allowlist: Allowlist) -> List[Finding]:
+    modules: List[_Module] = []
+    findings: List[Finding] = []
+    for path, src in sources:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="host-sync", path=path, line=e.lineno or 1, col=0,
+                snippet="", message=f"syntax error: {e.msg}"))
+            continue
+        modules.append(_Module(path, tree, src.splitlines()))
+
+    for mod in modules:
+        _collect_functions(mod)
+    _seed_traced(modules)
+
+    for mod in modules:
+        linter = _FileLinter(mod, findings)
+        linter.lint_module_wide()
+        traced_nodes = set()
+        for f in mod.functions:
+            if f.traced:
+                traced_nodes.add(id(f.node))
+                linter.lint_traced_function(f.node)
+        host_fns = [f.node for f in mod.functions
+                    if id(f.node) not in traced_nodes]
+        linter.lint_host_loops(host_fns)
+
+    seen = set()
+    unique: List[Finding] = []
+    for fd in findings:
+        key = (fd.rule, fd.path, fd.line, fd.col)
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(fd)
+    for fd in unique:
+        reason = allowlist.match(fd)
+        if reason is not None:
+            fd.allowed = True
+            fd.allowed_by = reason
+    unique.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return unique

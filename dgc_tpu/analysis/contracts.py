@@ -1,0 +1,275 @@
+"""Declarative contracts over lowered/compiled jax programs (layer 2).
+
+A :class:`Contract` wraps a jitted function plus example args, lowers it
+once (lazily, memoized), and checks a set of *expectations* against the
+program text::
+
+    Contract("flat-step", step_fn, args=(state, batch)) \\
+        .expects(collectives={"all-gather": 2, "all-reduce": 2},
+                 donation=[0],
+                 forbid_ops=["optimization-barrier"],
+                 forbid_substrings=["telemetry"]) \\
+        .enforce()
+
+``check()`` returns a list of violation strings; ``enforce()`` raises
+:class:`ContractViolation` listing all of them at once (a failing suite
+shows every broken expectation, not just the first).
+
+Counting happens on the *lowered* StableHLO text (reliable op identity);
+donation is read from the *compiled* module's ``input_output_alias``
+header (where aliasing actually materializes). See
+:mod:`dgc_tpu.analysis.hlo` for why.
+
+:class:`RecompileGuard` traps ``jax.jit`` cache misses: it snapshots
+``fn._cache_size()`` and asserts the expected number of new traces after
+a block of calls — the cheap way to prove config flags are static.
+"""
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from dgc_tpu.analysis import hlo
+
+__all__ = ["Contract", "ContractViolation", "RecompileGuard",
+           "trace_count"]
+
+
+class ContractViolation(AssertionError):
+    """One or more contract expectations failed."""
+
+    def __init__(self, name: str, violations: Sequence[str]):
+        self.name = name
+        self.violations = list(violations)
+        bullet = "\n".join(f"  - {v}" for v in self.violations)
+        super().__init__(f"contract {name!r}: "
+                         f"{len(self.violations)} violation(s)\n{bullet}")
+
+
+class Contract:
+    """A named set of expectations over one lowered program.
+
+    Parameters
+    ----------
+    name: label used in violation messages.
+    fn: the function to lower. Either already-lowered (has ``as_text``),
+        a jitted/plain callable (lowered via ``jax.jit(fn).lower``), or
+        omitted when ``lowered_text`` is passed directly (unit tests).
+    args/kwargs: example arguments for lowering.
+    """
+
+    def __init__(self, name: str, fn: Optional[Callable] = None,
+                 args: Sequence = (), kwargs: Optional[dict] = None,
+                 lowered_text: Optional[str] = None,
+                 compiled_text: Optional[str] = None):
+        self.name = name
+        self._fn = fn
+        self._args = tuple(args)
+        self._kwargs = dict(kwargs or {})
+        self._lowered = None
+        self._lowered_text = lowered_text
+        self._compiled_text = compiled_text
+        self._expectations: List[Callable[[], List[str]]] = []
+
+    # -- lazy lowering ---------------------------------------------------
+    def _lower(self):
+        if self._lowered is None:
+            fn = self._fn
+            if hasattr(fn, "as_text"):          # already a Lowered
+                self._lowered = fn
+            else:
+                import jax
+                wrapped = fn if hasattr(fn, "lower") else jax.jit(fn)
+                self._lowered = wrapped.lower(*self._args, **self._kwargs)
+        return self._lowered
+
+    @property
+    def lowered_text(self) -> str:
+        if self._lowered_text is None:
+            self._lowered_text = self._lower().as_text()
+        return self._lowered_text
+
+    @property
+    def compiled_text(self) -> str:
+        if self._compiled_text is None:
+            self._compiled_text = self._lower().compile().as_text()
+        return self._compiled_text
+
+    # -- expectation builders --------------------------------------------
+    def expects(self, collectives: Optional[Dict[str, int]] = None,
+                donation: Optional[Sequence[int]] = None,
+                forbid_ops: Optional[Sequence[str]] = None,
+                require_ops: Optional[Sequence[str]] = None,
+                forbid_substrings: Optional[Sequence[str]] = None,
+                no_f64: bool = False,
+                identical_to: Optional["Contract"] = None,
+                collectives_delta: Optional[
+                    Union["Contract", tuple]] = None) -> "Contract":
+        """Register expectations (chainable; all checked together).
+
+        collectives: exact count per collective op in the lowered module;
+            ops not named are unconstrained. Accepts ``all_gather`` or
+            ``all-gather`` spelling.
+        donation: param indices that MUST alias outputs in compiled HLO.
+            ``[]`` means *no* aliasing may be present (donate=False).
+        forbid_ops / require_ops: stablehlo op names with zero /
+            at-least-one occurrences in the lowered module.
+        forbid_substrings: raw substrings that must not appear in the
+            lowered text (e.g. ``"telemetry"`` op metadata).
+        no_f64: no f64 tensor type anywhere in the lowered module.
+        identical_to: another Contract whose lowered text must match
+            byte-for-byte (the telemetry-off == never-built pin).
+        collectives_delta: ``(baseline_contract, {op: delta})`` — this
+            program has exactly ``baseline + delta`` of each named op.
+        """
+        if collectives is not None:
+            want = {hlo.normalize_op(k): v for k, v in collectives.items()}
+            self._expectations.append(lambda: self._check_collectives(want))
+        if donation is not None:
+            dons = sorted(donation)
+            self._expectations.append(lambda: self._check_donation(dons))
+        for op in (forbid_ops or ()):
+            self._expectations.append(
+                lambda op=hlo.normalize_op(op): self._check_op(op, forbid=True))
+        for op in (require_ops or ()):
+            self._expectations.append(
+                lambda op=hlo.normalize_op(op): self._check_op(op,
+                                                               forbid=False))
+        for s in (forbid_substrings or ()):
+            self._expectations.append(
+                lambda s=s: self._check_substring(s))
+        if no_f64:
+            self._expectations.append(self._check_no_f64)
+        if identical_to is not None:
+            self._expectations.append(
+                lambda: self._check_identical(identical_to))
+        if collectives_delta is not None:
+            base, delta = collectives_delta
+            want_d = {hlo.normalize_op(k): v for k, v in delta.items()}
+            self._expectations.append(
+                lambda: self._check_delta(base, want_d))
+        return self
+
+    # -- individual checks ------------------------------------------------
+    def _check_collectives(self, want: Dict[str, int]) -> List[str]:
+        got = hlo.collective_counts(self.lowered_text)
+        return [f"collective {op}: expected {n}, lowered module has "
+                f"{got.get(op, 0)}"
+                for op, n in sorted(want.items()) if got.get(op, 0) != n]
+
+    def _check_donation(self, want: List[int]) -> List[str]:
+        got = hlo.donated_params(self.compiled_text)
+        if want and not got:
+            return [f"donation: expected params {want} to alias outputs, "
+                    "but compiled module has no input_output_alias — "
+                    "donation silently dropped"]
+        if not want and got:
+            return [f"donation: expected no aliasing, but params {got} "
+                    "alias outputs"]
+        missing = [p for p in want if p not in got]
+        if missing:
+            return [f"donation: params {missing} not aliased "
+                    f"(compiled module aliases {got})"]
+        return []
+
+    def _check_op(self, op: str, forbid: bool) -> List[str]:
+        n = hlo.count_op(self.lowered_text, op)
+        if forbid and n:
+            return [f"forbidden op {op}: {n} occurrence(s) in lowered "
+                    "module"]
+        if not forbid and not n:
+            return [f"required op {op}: absent from lowered module"]
+        return []
+
+    def _check_substring(self, s: str) -> List[str]:
+        n = self.lowered_text.count(s)
+        if n:
+            return [f"forbidden substring {s!r}: {n} occurrence(s) in "
+                    "lowered module"]
+        return []
+
+    def _check_no_f64(self) -> List[str]:
+        if hlo.has_f64(self.lowered_text):
+            return ["f64 tensor type present in lowered module "
+                    "(pipeline contract is f32/bf16 end-to-end)"]
+        return []
+
+    def _check_identical(self, other: "Contract") -> List[str]:
+        a, b = self.lowered_text, other.lowered_text
+        if a == b:
+            return []
+        return [f"lowered module differs from {other.name!r} "
+                f"(must be byte-identical):\n"
+                + hlo.diff_summary(a, b, self.name, other.name)]
+
+    def _check_delta(self, base: "Contract",
+                     delta: Dict[str, int]) -> List[str]:
+        mine = hlo.collective_counts(self.lowered_text)
+        theirs = hlo.collective_counts(base.lowered_text)
+        out = []
+        for op, d in sorted(delta.items()):
+            got = mine.get(op, 0) - theirs.get(op, 0)
+            if got != d:
+                out.append(f"collective delta {op}: expected "
+                           f"{base.name!r}+{d}, got "
+                           f"{theirs.get(op, 0)}+{got}")
+        return out
+
+    # -- evaluation --------------------------------------------------------
+    def check(self) -> List[str]:
+        """Run all expectations; return violation strings (empty = pass)."""
+        out: List[str] = []
+        for exp in self._expectations:
+            out.extend(exp())
+        return out
+
+    def enforce(self) -> "Contract":
+        violations = self.check()
+        if violations:
+            raise ContractViolation(self.name, violations)
+        return self
+
+
+# ------------------------------------------------------------------------ #
+# recompile guard                                                           #
+# ------------------------------------------------------------------------ #
+
+def trace_count(jitted) -> int:
+    """Number of traces cached on a jitted function (0 before first call)."""
+    size = getattr(jitted, "_cache_size", None)
+    if size is None:
+        raise TypeError(f"{jitted!r} is not a jax.jit wrapper "
+                        "(no _cache_size)")
+    return size()
+
+
+class RecompileGuard:
+    """Trap unexpected jax.jit cache misses across a block of calls.
+
+    Usage::
+
+        with RecompileGuard(step_fn, expect=1):
+            step_fn(state, batch)     # traces
+            step_fn(state2, batch2)   # same shapes: must hit the cache
+
+    Exiting the block asserts exactly ``expect`` NEW traces happened.
+    A higher count means a config flag leaked into the trace cache key
+    (e.g. a fresh closure or an unhashable static arg per call)."""
+
+    def __init__(self, jitted, expect: int = 1, name: str = ""):
+        self.jitted = jitted
+        self.expect = expect
+        self.name = name or getattr(jitted, "__name__", repr(jitted))
+        self._start = None
+
+    def __enter__(self) -> "RecompileGuard":
+        self._start = trace_count(self.jitted)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return
+        got = trace_count(self.jitted) - self._start
+        if got != self.expect:
+            raise ContractViolation(
+                f"recompile-guard:{self.name}",
+                [f"expected {self.expect} new trace(s), observed {got} — "
+                 "a supposedly-static config is part of the cache key"])

@@ -1,0 +1,114 @@
+"""Parsers over lowered / compiled program text (dgclint layer 2 helpers).
+
+Two text forms matter and they are NOT interchangeable:
+
+* **StableHLO** (``fn.lower(*args).as_text()``) — the pre-optimization
+  module. Op identity is reliable here: one textual ``stablehlo.all_gather``
+  per ``lax.all_gather`` call, ``optimization_barrier`` still present,
+  f64 types spelled ``f64``/``tensor<...xf64>``. All op *counting* in this
+  module uses the lowered text.
+* **Optimized HLO** (``fn.lower(*args).compile().as_text()``) — the
+  post-pass backend module. On CPU, collectives get expanded/cloned and
+  op metadata re-mentions source names, so substring counting lies; the
+  only thing we read from compiled text is the ``input_output_alias``
+  header, which is where donation actually materializes.
+
+Everything here is pure string/regex work so it stays testable without
+building real programs.
+"""
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "op_counts", "collective_counts", "count_op", "opt_barrier_count",
+    "has_f64", "donated_params", "normalize_op", "COLLECTIVE_OPS",
+]
+
+#: canonical (hyphenated, HLO-style) names of cross-replica collectives
+COLLECTIVE_OPS = ("all-gather", "all-reduce", "all-to-all",
+                  "collective-permute", "reduce-scatter")
+
+_STABLEHLO_OP_RE = re.compile(r"\bstablehlo\.(\w+)")
+_F64_RE = re.compile(r"\bf64\b|xf64>")
+
+
+def normalize_op(name: str) -> str:
+    """'all_gather' / 'stablehlo.all_gather' / 'all-gather' -> 'all-gather'.
+
+    Contracts accept either spelling; internally everything is hyphenated
+    to match HLO convention."""
+    name = name.split(".")[-1]
+    return name.replace("_", "-")
+
+
+def op_counts(lowered_text: str) -> Dict[str, int]:
+    """Histogram of stablehlo ops in a *lowered* (pre-optimization) module.
+
+    Keys are hyphenated (``all-gather``, ``optimization-barrier``)."""
+    counts: Dict[str, int] = {}
+    for m in _STABLEHLO_OP_RE.finditer(lowered_text):
+        op = normalize_op(m.group(1))
+        counts[op] = counts.get(op, 0) + 1
+    return counts
+
+
+def count_op(lowered_text: str, op: str) -> int:
+    return op_counts(lowered_text).get(normalize_op(op), 0)
+
+
+def collective_counts(lowered_text: str) -> Dict[str, int]:
+    """Counts of just the cross-replica collectives (zero-filled)."""
+    counts = op_counts(lowered_text)
+    return {op: counts.get(op, 0) for op in COLLECTIVE_OPS}
+
+
+def opt_barrier_count(lowered_text: str) -> int:
+    return count_op(lowered_text, "optimization_barrier")
+
+
+def has_f64(text: str) -> bool:
+    """True if any f64 tensor type appears (works on lowered text; HLO
+    compiled text spells the type ``f64[...]`` which the word-boundary
+    pattern also catches)."""
+    return _F64_RE.search(text) is not None
+
+
+def donated_params(compiled_text: str) -> List[int]:
+    """Parameter indices that alias an output in optimized HLO.
+
+    Parses the module header, e.g.::
+
+        input_output_alias={ {0}: (0, {0}, may-alias), {1}: (0, {1}, ...) }
+
+    Each value tuple is ``(param_number, param_index, kind)``; we return
+    the sorted distinct param numbers. Empty list when the header is
+    absent or empty — i.e. nothing was donated/aliased."""
+    start = compiled_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    # the header section nests braces ({out_index}: (p, {p_index}, kind));
+    # scan to the balanced close instead of regexing to the first '}'
+    i = start + len("input_output_alias={")
+    depth = 1
+    while i < len(compiled_text) and depth:
+        depth += {"{": 1, "}": -1}.get(compiled_text[i], 0)
+        i += 1
+    body = compiled_text[start:i]
+    params = set()
+    # value tuples look like "(3, {0, 1}, may-alias)" — param number first
+    for t in re.finditer(r"\(\s*(\d+)\s*,\s*\{[^}]*\}", body):
+        params.add(int(t.group(1)))
+    return sorted(params)
+
+
+def diff_summary(a: str, b: str, label_a: str = "a", label_b: str = "b",
+                 context: int = 2, max_lines: int = 40) -> str:
+    """Small unified-ish diff for contract failure messages."""
+    import difflib
+    lines = list(difflib.unified_diff(
+        a.splitlines(), b.splitlines(), fromfile=label_a, tofile=label_b,
+        n=context, lineterm=""))
+    if len(lines) > max_lines:
+        lines = lines[:max_lines] + [f"... ({len(lines) - max_lines} more)"]
+    return "\n".join(lines)

@@ -1,0 +1,149 @@
+"""Rule catalog + allowlist for the dgclint AST layer.
+
+Every rule is a static description; the detection logic lives in
+:mod:`dgc_tpu.analysis.astlint` (one visitor, dispatching per rule id).
+Rules target the hazards that silently break the DGC compiled-step
+contract (ISSUE 3; docs/ANALYSIS.md has the full catalog with examples):
+
+* a host sync inside jitted scope turns the paper's "one sparse exchange
+  per step" into a device round-trip per call site;
+* a Python branch on a tracer either crashes at trace time or — worse —
+  silently bakes one side into the compiled program;
+* a float64 literal upcasts whole fusions (TPUs emulate f64 in software);
+* host entropy (``time.time``, ``np.random``) freezes into the trace;
+* a jit that threads dead state without ``donate_argnums`` doubles HBM.
+
+Audited exceptions are recorded in ``allowlist.toml`` next to this file
+(rule + file glob + source-line substring + one-line justification), or
+inline with a ``# dgclint: ok`` / ``# dgclint: ok[rule-id]`` comment for
+fixture-style single-line waivers.
+"""
+
+import fnmatch
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Rule", "RULES", "RULES_BY_ID", "Finding", "Allowlist",
+           "load_allowlist", "DEFAULT_ALLOWLIST_PATH"]
+
+DEFAULT_ALLOWLIST_PATH = os.path.join(os.path.dirname(__file__),
+                                      "allowlist.toml")
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str             # stable kebab-case id, used in allowlists/waivers
+    code: str           # short numeric code for terse output (DGC1xx)
+    summary: str        # one line, shown next to each finding
+    traced_only: bool   # rule only fires inside traced (jitted) scope
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule("host-sync", "DGC101",
+         "host-synchronizing call reachable from jitted scope "
+         "(float()/int() on a tracer, .item(), np.asarray, "
+         "jax.device_get, print)", True),
+    Rule("tracer-branch", "DGC102",
+         "Python if/while/assert on a tracer-valued expression in "
+         "jitted scope (use lax.cond/select or hoist to static)", True),
+    Rule("f64-dtype", "DGC103",
+         "float64 literal or dtype drift (TPU emulates f64; the DGC "
+         "pipeline contract is f32 end-to-end)", False),
+    Rule("static-argnums", "DGC104",
+         "jax.jit static_argnums/static_argnames must be a hashable "
+         "literal (int/str or tuple thereof), not a list or a computed "
+         "expression", False),
+    Rule("missing-donate", "DGC105",
+         "jitted state-threading function without donate_argnums: the "
+         "dead input buffer doubles peak HBM", False),
+    Rule("host-entropy", "DGC106",
+         "host time/RNG in traced code (time.time, np.random, random): "
+         "the value freezes into the compiled program", True),
+    Rule("sync-in-loop", "DGC107",
+         "per-iteration host conversion on step outputs inside a driver "
+         "loop (float()/int()/.item()/device_get): stalls the dispatch "
+         "pipeline every iteration — batch the reads after the loop",
+         False),
+)
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}
+
+#: inline waiver: ``# dgclint: ok`` (any rule) or ``# dgclint: ok[id,id]``
+_WAIVER_RE = re.compile(r"#\s*dgclint:\s*ok(?:\[([a-z0-9_,\- ]+)\])?")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str           # posix path relative to the lint root
+    line: int
+    col: int
+    snippet: str        # the offending source line, stripped
+    message: str
+    allowed: bool = False
+    allowed_by: str = ""   # "inline" or the allowlist reason
+
+    def format(self) -> str:
+        mark = f"  [allowed: {self.allowed_by}]" if self.allowed else ""
+        code = RULES_BY_ID[self.rule].code
+        return (f"{self.path}:{self.line}:{self.col}: {code} "
+                f"[{self.rule}] {self.message}{mark}\n"
+                f"    {self.snippet}")
+
+
+@dataclass
+class Allowlist:
+    """Audited exceptions: entries match (rule, file glob, line substring).
+
+    ``contains`` is matched against the offending *source line* — robust
+    across line-number drift, unlike path:line pins. An empty ``contains``
+    allows the rule for the whole file (use sparingly)."""
+    entries: List[dict] = field(default_factory=list)
+
+    def match(self, finding: Finding) -> Optional[str]:
+        for e in self.entries:
+            if e.get("rule") and e["rule"] != finding.rule:
+                continue
+            if not fnmatch.fnmatch(finding.path, e.get("file", "*")):
+                continue
+            contains = e.get("contains", "")
+            if contains and contains not in finding.snippet:
+                continue
+            return e.get("reason", "allowlisted")
+        return None
+
+    @staticmethod
+    def inline_waiver(source_line: str, rule: str) -> bool:
+        m = _WAIVER_RE.search(source_line)
+        if not m:
+            return False
+        if m.group(1) is None:
+            return True
+        ids = {s.strip() for s in m.group(1).split(",")}
+        return rule in ids
+
+
+def load_allowlist(path: Optional[str] = None) -> Allowlist:
+    """Parse ``allowlist.toml`` (tomllib on 3.11+, tomli before)."""
+    path = path or DEFAULT_ALLOWLIST_PATH
+    if not os.path.exists(path):
+        return Allowlist()
+    try:
+        import tomllib
+    except ImportError:             # Python < 3.11: the vendored reader
+        import tomli as tomllib
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    entries = list(data.get("allow", []))
+    for e in entries:
+        if "reason" not in e or not str(e["reason"]).strip():
+            raise ValueError(
+                f"allowlist entry {e} lacks a reason — every audited "
+                "exception must carry a one-line justification")
+        if e.get("rule") and e["rule"] not in RULES_BY_ID:
+            raise ValueError(f"allowlist entry names unknown rule "
+                             f"{e['rule']!r} (known: "
+                             f"{sorted(RULES_BY_ID)})")
+    return Allowlist(entries)

@@ -1,0 +1,226 @@
+"""The repo's standing contract suite (dgclint layer 2).
+
+Pins the paper-level guarantees of the compiled flat train step on a tiny
+Conv+BN+Dense model over 8 (fake) devices — the same geometry the tier-1
+tests exercise:
+
+* **one sparse exchange**: the plain DGC step lowers to exactly 2
+  all-gathers (payload values + transmit records) and 2 all-reduces
+  (dense tail + loss mean); the dense engine drops to 0 gathers.
+* **telemetry rides free**: telemetry=True adds exactly ONE packed
+  all-reduce (taps.pmean_stats); telemetry=False is byte-identical to a
+  build that never mentioned telemetry.
+* **donation aliases**: donate=True materializes input_output_alias for
+  the state buffers (param 0 included); donate=False aliases nothing.
+* **fused-apply epilogue is barrier-free**: kernels.payload_apply_bits
+  lowers without optimization_barrier ops (PR 1's fused epilogue).
+* **f32 end-to-end**: no f64 tensor type in any variant.
+* **trace stability**: same-shape calls never retrace.
+* **shard_state stays collective-free** (source contract): the
+  multi-process assembly path uses jax.make_array_from_callback and never
+  re-introduces multihost broadcasts (the gloo hang fixed in PR 2).
+
+``run_contract_suite()`` returns ``(name, violations)`` pairs;
+``python -m dgc_tpu.analysis --contracts`` gates on them.
+"""
+
+import os
+from typing import Callable, List, Optional, Tuple
+
+from dgc_tpu.analysis.contracts import Contract, RecompileGuard
+
+__all__ = ["run_contract_suite", "build_fixture", "shard_state_source_check"]
+
+#: calibrated on the 8-device CPU mesh; the counts are backend-agnostic
+#: (they come from the lax-level program, not backend expansion)
+FLAT_COLLECTIVES = {"all-gather": 2, "all-reduce": 2}
+DENSE_COLLECTIVES = {"all-gather": 0, "all-reduce": 2}
+
+
+def build_fixture(mesh=None, world: int = 8, compressor: str = "dgc",
+                  **step_kwargs):
+    """(state, step, setup, (images, labels, key)) on a tiny model.
+
+    Mirrors tests/test_telemetry.py's ``flat_step_pair`` geometry; any
+    ``build_train_step`` kwarg passes through (donate/telemetry/...)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from dgc_tpu import (DGCCompressor, DGCSGDMemory, DistributedOptimizer,
+                         NoneCompressor, dgc_sgd)
+    from dgc_tpu.parallel import make_mesh
+    from dgc_tpu.training import (build_train_step, make_flat_setup,
+                                  make_flat_state, shard_state)
+    from dgc_tpu.utils.pytree import named_flatten
+
+    if mesh is None:
+        mesh = make_mesh(world)
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x.mean(axis=(1, 2)))
+
+    model = M()
+    v = dict(model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3))))
+
+    def apply_fn(variables, x, train=True, mutable=None, rngs=None):
+        if mutable:  # dgclint: ok[tracer-branch] — mutable is a static collection list
+
+            return model.apply(variables, x, train=train, mutable=mutable,
+                               rngs=rngs)
+        return model.apply(variables, x, train=train)
+
+    if compressor == "dgc":
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9))
+        named, _ = named_flatten(v["params"])
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    elif compressor == "none":
+        comp = NoneCompressor()
+    else:
+        raise ValueError(f"unknown compressor {compressor!r}")
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=world)
+    setup = make_flat_setup(v, dist)
+    state = shard_state(make_flat_state(v, dist, setup, world), mesh,
+                        dist_opt=dist)
+    step = build_train_step(apply_fn, dist, mesh, flat=setup, **step_kwargs)
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(world * 4, 16, 16, 3), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, world * 4), jnp.int32)
+    return state, step, setup, (images, labels, jax.random.PRNGKey(1))
+
+
+def _step_contract(name, state, step, inputs, **expects) -> Contract:
+    images, labels, key = inputs
+    return Contract(name, step,
+                    args=(state, images, labels, key)).expects(**expects)
+
+
+def shard_state_source_check(root: Optional[str] = None) -> List[str]:
+    """Source contract for the gloo shard_state fix (PR 2): the
+    multi-process state-assembly branch must build global arrays with
+    ``jax.make_array_from_callback`` (collective-free) and must not call
+    multihost broadcast/assert helpers — those deadlock heterogeneous
+    gloo meshes during state assembly."""
+    import ast
+
+    root = root or os.getcwd()
+    path = os.path.join(root, "dgc_tpu", "training", "state.py")
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    # identifiers only — the module's comments legitimately *discuss* the
+    # broadcast helpers it must not call
+    idents = {n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)}
+    idents |= {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+    idents |= {a.name for n in ast.walk(tree)
+               if isinstance(n, (ast.Import, ast.ImportFrom))
+               for a in n.names}
+    out = []
+    if "make_array_from_callback" not in idents:
+        out.append("training/state.py: make_array_from_callback missing — "
+                   "the collective-free multi-process assembly path is gone")
+    for banned in ("multihost_utils", "assert_equal", "broadcast_one_to_all",
+                   "sync_global_devices"):
+        if banned in idents:
+            out.append(f"training/state.py: {banned!r} referenced — "
+                       "state assembly must stay collective-free")
+    return out
+
+
+def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
+                       root: Optional[str] = None
+                       ) -> List[Tuple[str, List[str]]]:
+    """Run every standing contract; returns (name, violations) pairs."""
+    import jax
+
+    say = log or (lambda s: None)
+    results: List[Tuple[str, List[str]]] = []
+
+    def run(name, fn):
+        say(f"contract: {name}")
+        try:
+            results.append((name, fn()))
+        except Exception as e:      # build/lower failure is a violation too
+            results.append((name, [f"errored: {type(e).__name__}: {e}"]))
+
+    state, step_plain, setup, inputs = build_fixture(
+        mesh, donate=False, telemetry=False)
+    plain = _step_contract(
+        "flat-step-one-sparse-exchange", state, step_plain, inputs,
+        collectives=FLAT_COLLECTIVES, donation=[], no_f64=True)
+    run(plain.name, plain.check)
+
+    _, step_telem, _, _ = build_fixture(mesh, donate=False, telemetry=True)
+    telem = _step_contract(
+        "telemetry-on-exactly-one-pmean", state, step_telem, inputs,
+        collectives_delta=(plain, {"all-reduce": 1, "all-gather": 0}),
+        no_f64=True)
+    run(telem.name, telem.check)
+
+    # a build that never names telemetry= must produce the same bytes as
+    # telemetry=False: proof the flag is Python-static, not a traced no-op
+    _, step_default, _, _ = build_fixture(mesh, donate=False)
+    off = _step_contract(
+        "telemetry-off-compiles-away", state, step_plain, inputs,
+        forbid_substrings=["telemetry"],
+        identical_to=_step_contract("telemetry-never-built", state,
+                                    step_default, inputs))
+    run(off.name, off.check)
+
+    _, step_don, _, _ = build_fixture(mesh, donate=True)
+    don = _step_contract(
+        "donated-state-aliases-outputs", state, step_don, inputs,
+        donation=[0])
+    run(don.name, don.check)
+
+    # the dense engine has its own memory/opt-state geometry: lower it
+    # against its own fixture state, not the DGC one
+    state_d, step_dense, _, _ = build_fixture(mesh, compressor="none",
+                                              donate=False)
+    dense = _step_contract(
+        "dense-engine-no-gathers", state_d, step_dense, inputs,
+        collectives=DENSE_COLLECTIVES, no_f64=True)
+    run(dense.name, dense.check)
+
+    run("fused-epilogue-no-opt-barriers",
+        lambda: _epilogue_contract().check())
+
+    def recompile():
+        images, labels, key = inputs
+        with RecompileGuard(step_plain, expect=1,
+                            name="flat-step-same-shapes"):
+            step_plain(state, images, labels, key)
+            step_plain(state, images, labels, jax.random.PRNGKey(2))
+        return []
+    run("recompile-guard-same-shapes", recompile)
+
+    run("shard-state-collective-free",
+        lambda: shard_state_source_check(root))
+    return results
+
+
+def _epilogue_contract() -> Contract:
+    """PR 1's fused payload-apply epilogue must lower barrier-free: an
+    optimization_barrier between decompress and apply would pin the
+    intermediate accumulator and defeat the single-pass fusion (see the
+    note on kernels.opaque_view)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgc_tpu.ops import kernels
+
+    total = 4096
+    values = jnp.ones((256,), jnp.float32)
+    indices = jnp.arange(256, dtype=jnp.int32)
+    flags = jnp.ones((256,), jnp.bool_)
+    fn = jax.jit(lambda v, i, f: kernels.payload_apply_bits(v, i, f, total))
+    return Contract("fused-epilogue-no-opt-barriers", fn,
+                    args=(values, indices, flags)).expects(
+        forbid_ops=["optimization-barrier"], no_f64=True)

@@ -298,7 +298,7 @@ class DGCCompressor(Compressor):
         return ops.select_by_threshold(flat, importance, threshold,
                                        attrs.num_selects)
 
-    def compress(self, mem_state, name, grad, key):
+    def compress(self, mem_state, name: str, grad, key):
         """Momentum-corrected sparsification (compression.py:155-177)."""
         if self.compress_ratio < 1.0 and name in self.attributes:
             attrs = self.attributes[name]

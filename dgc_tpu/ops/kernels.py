@@ -92,8 +92,8 @@ def fused_compensate_reference(grad, mmt, vec, momentum: float,
     return mmt.astype(sdt), vec.astype(sdt)
 
 
-def _compensate_kernel(g_ref, m_ref, v_ref, om_ref, ov_ref, *, momentum,
-                       nesterov):
+def _compensate_kernel(g_ref, m_ref, v_ref, om_ref, ov_ref, *,
+                       momentum: float, nesterov: bool):
     g = g_ref[:]
     m0 = m_ref[:].astype(g.dtype)
     v0 = v_ref[:].astype(g.dtype)
@@ -189,7 +189,8 @@ def fused_compensate_masked_reference(grad, mmt, vec, sent, momentum: float,
 
 
 def _compensate_masked_kernel(g_ref, m_ref, v_ref, k_ref, om_ref, ov_ref, *,
-                              momentum, nesterov, momentum_masking):
+                              momentum: float, nesterov: bool,
+                              momentum_masking: bool):
     g = g_ref[:]
     # sent is the f32 transmit count (sub-word masks are NOT used: their
     # scatter lowers to a serial while-loop on v5e, see
@@ -342,8 +343,8 @@ def fused_compensate_bits_reference(grad, mmt, vec, bits, momentum: float,
     return om.astype(sdt), ov.astype(sdt)
 
 
-def _bits_compensate_core(g_ref, m_ref, v_ref, b_ref, *, momentum,
-                          nesterov, momentum_masking):
+def _bits_compensate_core(g_ref, m_ref, v_ref, b_ref, *, momentum: float,
+                          nesterov: bool, momentum_masking: bool):
     """Shared VMEM body of the bit-masked compensate kernels: in-VMEM
     bit expansion + mask-on-read + momentum correction. ONE source of
     truth so the plain kernel and the fused candidates kernel cannot
@@ -1183,7 +1184,10 @@ def opaque_view(x: jax.Array) -> jax.Array:
     a 67 MB fc2 slice; the dense arm fuses the same converts into its
     convolutions). ``optimization_barrier`` does NOT stop the rewrite —
     barriers are stripped before the late backend pass that forms these
-    convert-reshapes (the optimized HLO contains no opt-barrier ops). A
+    convert-reshapes (the optimized HLO contains no opt-barrier ops; the
+    fused-apply epilogue's barrier-free lowering is pinned by the
+    ``fused-epilogue-no-opt-barriers`` contract in
+    ``dgc_tpu/analysis/suite.py``). A
     custom call is never looked through, so the per-tensor copy this
     kernel pays (proportional to the TENSOR, ~0.2 ms for fc2) replaces
     the whole-buffer converts, and the convert of its output fuses into

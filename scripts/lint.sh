@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Fast AST-only dgclint pass (no jax import, milliseconds) — the
+# edit-loop companion to the full `python -m dgc_tpu.analysis --gate`
+# wired into scripts/t1.sh. Extra args pass through, e.g.:
+#   scripts/lint.sh --show-allowed
+#   scripts/lint.sh bench.py scripts   # lint beyond the default roots
+set -e
+cd "$(dirname "$0")/.."
+exec python -m dgc_tpu.analysis --lint "$@"

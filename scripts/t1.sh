@@ -17,4 +17,10 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "TELEMETRY_SMOKE=ok" || { echo "TELEMETRY_SMOKE=FAIL"; rc=1; }
+# dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
+# compiled-program contract suite — nonzero on any un-allowlisted finding
+# or broken step invariant (one sparse exchange, telemetry compiles away,
+# donation aliases, barrier-free fused epilogue)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --gate \
+  && echo "ANALYSIS_GATE=ok" || { echo "ANALYSIS_GATE=FAIL"; rc=1; }
 exit $rc

@@ -1,0 +1,8 @@
+"""Clean twin: f32/bf16 stay f32/bf16."""
+import jax.numpy as jnp
+
+
+def make_table(n):
+    base = jnp.zeros((n,), dtype=jnp.float32)
+    narrow = base.astype(jnp.bfloat16)
+    return base, narrow.astype(jnp.float32)

@@ -1,0 +1,10 @@
+"""Seeded violations: float64 literals / dtype drift."""
+import numpy as np
+import jax.numpy as jnp
+
+
+def make_table(n):
+    scale = np.float64(1.5)  # LINT: f64-dtype
+    base = jnp.zeros((n,), dtype="float64")  # LINT: f64-dtype
+    wide = base.astype(float)  # LINT: f64-dtype
+    return scale, wide

@@ -1,0 +1,13 @@
+"""Clean twin: jax.random with threaded keys; host timing stays host."""
+import time
+
+import jax
+
+
+@jax.jit
+def seeded(x, key):
+    return x + jax.random.normal(key, x.shape)
+
+
+def host_timer():
+    return time.time()

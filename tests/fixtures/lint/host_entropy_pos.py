@@ -1,0 +1,14 @@
+"""Seeded violations: host time/RNG frozen into a traced program."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def noisy(x):
+    t = time.time()  # LINT: host-entropy
+    r = np.random.rand()  # LINT: host-entropy
+    s = random.random()  # LINT: host-entropy
+    return x * t * r * s

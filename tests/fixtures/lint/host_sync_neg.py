@@ -1,0 +1,16 @@
+"""Clean twin of host_sync_pos: no host syncs in traced scope."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_loss(params, batch):
+    if params is None:
+        return jnp.zeros(())
+    rank = len(batch.shape)
+    return jnp.mean(params * batch) * rank
+
+
+def host_driver(results):
+    # untraced host function: converting fetched values is the job
+    return [float(r) for r in results]

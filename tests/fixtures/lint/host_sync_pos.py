@@ -1,0 +1,17 @@
+"""Seeded violations: host syncs inside jitted scope.
+
+`# LINT: <rule-id>` marks the lines tests expect the linter to flag."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_loss(params, batch):
+    loss = jnp.mean(params * batch)
+    print("loss is", loss)  # LINT: host-sync
+    scale = float(loss)  # LINT: host-sync
+    host = np.asarray(loss)  # LINT: host-sync
+    fetched = jax.device_get(loss)  # LINT: host-sync
+    item = loss.item()  # LINT: host-sync
+    return loss * scale + host + fetched + item

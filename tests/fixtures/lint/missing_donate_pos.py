@@ -1,0 +1,7 @@
+"""Seeded violation: jitted state-threading step without donation."""
+import jax
+
+
+@jax.jit
+def train_step(state, batch):  # LINT: missing-donate
+    return state, batch

@@ -1,0 +1,10 @@
+"""Clean twin: hashable tuple/int/str static specs."""
+import jax
+
+
+def build(fn):
+    return jax.jit(fn, static_argnums=(0, 1))
+
+
+def build_one(fn):
+    return jax.jit(fn, static_argnums=2, static_argnames="mode")

@@ -1,0 +1,10 @@
+"""Seeded violations: unhashable static_argnums/static_argnames."""
+import jax
+
+
+def build(fn):
+    return jax.jit(fn, static_argnums=[0, 1])  # LINT: static-argnums
+
+
+def build_named(fn):
+    return jax.jit(fn, static_argnames=["mode"])  # LINT: static-argnums

@@ -1,0 +1,11 @@
+"""Clean twin: device values collected in the loop, converted after."""
+
+
+def train(step_fn, state, batches, writer):
+    log = []
+    for batch in batches:
+        state, metrics = step_fn(state, batch)
+        log.append(metrics["loss"])
+    for loss in log:
+        writer.log(float(loss))
+    return state

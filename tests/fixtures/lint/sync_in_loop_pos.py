@@ -1,0 +1,16 @@
+"""Seeded violations: per-iteration host conversions in driver loops."""
+
+
+def train(step_fn, state, batches, writer):
+    for batch in batches:
+        state, metrics = step_fn(state, batch)
+        writer.log(float(metrics["loss"]))  # LINT: sync-in-loop
+    return state
+
+
+def evaluate(eval_fn, state, batches):
+    total = 0.0
+    for batch in batches:
+        counts = eval_fn(state, batch)
+        total += counts.item()  # LINT: sync-in-loop
+    return total

@@ -1,0 +1,18 @@
+"""Clean twin: branches on static config, shapes, and dtypes only."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def select(x, use_abs: bool = False, mode: str = "mean"):
+    if use_abs:
+        x = jnp.abs(x)
+    if mode == "mean":
+        r = x.mean()
+    else:
+        r = x.sum()
+    if x.shape[0] > 4:
+        r = r / 2.0
+    if x is not None and jnp.issubdtype(x.dtype, jnp.floating):
+        r = r + 1.0
+    return jnp.where(r > 0, r, -r)

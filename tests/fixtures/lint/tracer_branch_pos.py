@@ -1,0 +1,14 @@
+"""Seeded violations: Python control flow on tracer values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def select(x, threshold):
+    if jnp.any(x > threshold):  # LINT: tracer-branch
+        x = x * 2.0
+    while x.sum() > 1.0:  # LINT: tracer-branch
+        x = x * 0.5
+    assert x[0] > 0  # LINT: tracer-branch
+    y = x if x.mean() > 0 else -x  # LINT: tracer-branch
+    return y

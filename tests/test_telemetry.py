@@ -369,10 +369,14 @@ def test_step_telemetry_does_not_perturb_training(flat_step_pair):
 
 
 def test_step_telemetry_off_compiles_away(flat_step_pair):
+    # pinned through the standing contract mechanism (dgc_tpu.analysis);
+    # the full suite also checks byte-identity against a build that never
+    # names telemetry= (tests/test_analysis_contracts.py)
+    from dgc_tpu.analysis import Contract
     state, _, step_p, _, (images, labels) = flat_step_pair
-    txt = jax.jit(step_p).lower(state, images, labels,
-                                jax.random.PRNGKey(1)).as_text()
-    assert "telemetry" not in txt
+    Contract("telemetry-off-compiles-away", step_p,
+             args=(state, images, labels, jax.random.PRNGKey(1))).expects(
+        forbid_substrings=["telemetry"]).enforce()
 
 
 def test_step_telemetry_residual_energy_identity(flat_step_pair):

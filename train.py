@@ -64,6 +64,21 @@ def _narrow_model_dtype(model):
     return None
 
 
+def drain_loss_log(writer, loss_log):
+    """Convert the epoch's collected device losses in one go.
+
+    The train loop appends ``(num_inputs, device_scalar)`` pairs instead
+    of calling ``float()`` per logged step — a per-step conversion blocks
+    the dispatch pipeline behind every enqueued step. Draining here costs
+    one host sync per epoch, after all steps are in flight."""
+    loss = 0.0
+    for at, dev_loss in loss_log:
+        loss = float(dev_loss)
+        writer.add_scalar("loss/train", loss, at)
+    loss_log.clear()
+    return loss
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", nargs="+", required=True)
@@ -276,15 +291,21 @@ def main():
         for k, meter_cfg in configs.train.meters.items():
             meters[k.format(split)] = meter_cfg()
         ds = dataset[split]
+        totals = None
         for idx in epoch_batches(len(ds), eval_batch, epoch=0,
                                  shuffle=False):
             images, labels = ds.get_batch(idx)
             counts = eval_fn(state.params, state.batch_stats,
                              host_local_to_global(images, mesh),
                              host_local_to_global(labels, mesh))
-            n = int(counts["count"])
+            # accumulate the count dict on device — int() per batch would
+            # serialize eval behind every dispatched step
+            totals = counts if totals is None else jax.tree.map(
+                jnp.add, totals, counts)
+        if totals is not None:
+            n = int(totals["count"])
             for meter in meters.values():
-                meter.update_counts(int(counts[f"top{meter.k}"]), n)
+                meter.update_counts(int(totals[f"top{meter.k}"]), n)
         return {k: m.compute() for k, m in meters.items()}
 
     # sanity eval before training (reference train.py:190-193)
@@ -347,6 +368,7 @@ def main():
         t0 = time.time()
         seen = 0
         metrics = None
+        loss_log = []
         base_key = jax.random.PRNGKey(seed)
         # --profile traces the first 8 steps of the first trained epoch and
         # then keeps training normally (the trace stops, the epoch doesn't)
@@ -384,8 +406,10 @@ def main():
                     sink.write(num_inputs, metrics["telemetry"])
                 logged = bidx % 50 == 0
                 if logged:
-                    writer.add_scalar("loss/train", float(metrics["loss"]),
-                                      num_inputs)
+                    # keep the device scalar: float() here would block the
+                    # dispatch pipeline; drain_loss_log converts after the
+                    # epoch's steps are all enqueued (dgclint: sync-in-loop)
+                    loss_log.append((num_inputs, metrics["loss"]))
         finally:
             if batches is not None:  # release the prefetch thread on error
                 batches.close()
@@ -396,11 +420,11 @@ def main():
             printr("[warn] epoch produced no batches "
                    "(dataset smaller than the global batch with drop_last)")
         else:
-            loss = float(metrics["loss"])
+            if not logged:
+                loss_log.append((num_inputs, metrics["loss"]))
+            loss = drain_loss_log(writer, loss_log)
             printr(f"[loss] = {loss:.4f}  ({seen} steps, "
                    f"{dt / max(seen, 1) * 1000:.1f} ms/step)")
-            if not logged:
-                writer.add_scalar("loss/train", loss, num_inputs)
 
         meters = evaluate(state)
         best = False
