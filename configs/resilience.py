@@ -1,0 +1,43 @@
+"""Resilience knob (docs/RESILIENCE.md): append to any config stack to turn
+the fault-tolerance layer on:
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/resilience.py [--train.resilience.spike_window 50]
+
+What it enables:
+* in-graph step guards — a nonfinite-gradient/loss detector that skips the
+  optimizer AND compressor-memory update atomically (every worker takes
+  the same branch; zero extra collectives — the verdict rides the loss
+  psum), plus an optional loss-spike circuit breaker;
+* payload checksum — per-bucket integrity words over the sparse exchange
+  (values + indices), surfaced as the ``checksum_failures`` guard counter;
+* preemption safety — SIGTERM/SIGINT trigger an emergency atomic
+  checkpoint (full compressor memory, mid-epoch batch index) and a clean
+  distributed shutdown; resume continues at the exact next batch;
+* a watchdog thread that dumps all stacks + flushes telemetry when step
+  progress stalls.
+
+Guard counters ride the telemetry sink when configs/telemetry.py is also
+stacked. With this module absent the guards compile away byte-identically
+(the ``guards-off-compiles-away`` contract in dgc_tpu/analysis/suite.py).
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.resilience = Config()
+configs.train.resilience.enabled = True
+# skip the update when any worker sees a nonfinite gradient or loss
+configs.train.resilience.nonfinite_guard = True
+# loss-spike circuit breaker: skip steps whose mean loss exceeds
+# spike_factor x the rolling mean of the last spike_window finite losses
+# (0 disables the breaker)
+configs.train.resilience.spike_window = 0
+configs.train.resilience.spike_factor = 10.0
+# per-bucket integrity words over the sparse wire (values + indices);
+# incompatible with int8_values compression
+configs.train.resilience.checksum = False
+# dump thread stacks + flush telemetry after this many seconds without a
+# completed step (0 disables the watchdog)
+configs.train.resilience.watchdog_secs = 300
+# SIGTERM/SIGINT -> atomic full-state checkpoint before shutdown
+configs.train.resilience.emergency_checkpoint = True

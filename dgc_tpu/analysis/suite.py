@@ -14,6 +14,11 @@ tests exercise:
   the state buffers (param 0 included); donate=False aliases nothing.
 * **fused-apply epilogue is barrier-free**: kernels.payload_apply_bits
   lowers without optimization_barrier ops (PR 1's fused epilogue).
+* **guards cost nothing when off, no syncs when on**: guards=None is
+  byte-identical to a build that never mentioned guards (and lowers zero
+  resilience/guard or resilience/preempt code); guards=on (+ checksum)
+  adds ZERO collectives — the bad-worker verdict rides the existing loss
+  all-reduce and the checksum words ride the existing index all-gather.
 * **f32 end-to-end**: no f64 tensor type in any variant.
 * **trace stability**: same-shape calls never retrace.
 * **shard_state stays collective-free** (source contract): the
@@ -38,11 +43,14 @@ DENSE_COLLECTIVES = {"all-gather": 0, "all-reduce": 2}
 
 
 def build_fixture(mesh=None, world: int = 8, compressor: str = "dgc",
-                  **step_kwargs):
+                  compressor_kwargs=None, **step_kwargs):
     """(state, step, setup, (images, labels, key)) on a tiny model.
 
     Mirrors tests/test_telemetry.py's ``flat_step_pair`` geometry; any
-    ``build_train_step`` kwarg passes through (donate/telemetry/...)."""
+    ``build_train_step`` kwarg passes through (donate/telemetry/guards/
+    ...; a ``guards`` config also seeds the state's guard counters), and
+    ``compressor_kwargs`` augments the DGC compressor construction (e.g.
+    ``{"checksum": True}``)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -77,7 +85,8 @@ def build_fixture(mesh=None, world: int = 8, compressor: str = "dgc",
         return model.apply(variables, x, train=train)
 
     if compressor == "dgc":
-        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9))
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                             **(compressor_kwargs or {}))
         named, _ = named_flatten(v["params"])
         comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     elif compressor == "none":
@@ -87,8 +96,10 @@ def build_fixture(mesh=None, world: int = 8, compressor: str = "dgc",
     dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
                                 world_size=world)
     setup = make_flat_setup(v, dist)
-    state = shard_state(make_flat_state(v, dist, setup, world), mesh,
-                        dist_opt=dist)
+    state = shard_state(
+        make_flat_state(v, dist, setup, world,
+                        guards=step_kwargs.get("guards")),
+        mesh, dist_opt=dist)
     step = build_train_step(apply_fn, dist, mesh, flat=setup, **step_kwargs)
 
     rng = np.random.RandomState(0)
@@ -173,6 +184,31 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         identical_to=_step_contract("telemetry-never-built", state,
                                     step_default, inputs))
     run(off.name, off.check)
+
+    # guards=None must be byte-identical to a build that never mentioned
+    # guards (the resilience layer is Python-static), and the plain
+    # program must lower zero guard/preempt code
+    _, step_goff, _, _ = build_fixture(mesh, donate=False, telemetry=False,
+                                       guards=None)
+    goff = _step_contract(
+        "guards-off-compiles-away", state, step_goff, inputs,
+        forbid_substrings=["resilience/guard", "resilience/preempt"],
+        identical_to=plain)
+    run(goff.name, goff.check)
+
+    # guards + checksum on: the skip verdict rides the packed loss
+    # all-reduce and the checksum words ride the index all-gather, so the
+    # collective count is UNCHANGED — zero extra host syncs or exchanges
+    from dgc_tpu.resilience import GuardConfig
+    state_g, step_gon, _, _ = build_fixture(
+        mesh, donate=False, telemetry=False,
+        guards=GuardConfig(spike_window=8),
+        compressor_kwargs={"checksum": True})
+    gon = _step_contract(
+        "guards-on-no-new-collectives", state_g, step_gon, inputs,
+        collectives_delta=(plain, {"all-reduce": 0, "all-gather": 0}),
+        no_f64=True)
+    run(gon.name, gon.check)
 
     _, step_don, _, _ = build_fixture(mesh, donate=True)
     don = _step_contract(

@@ -94,6 +94,7 @@ class DGCCompressor(Compressor):
                  int8_values: bool = False,
                  int8_error_feedback: bool = True,
                  packed_indices: bool = False,
+                 checksum: bool = False,
                  fused_apply: bool = False,
                  approx_recall: float = 0.90, verbose: bool = False):
         self.fp16_values = fp16_values
@@ -136,6 +137,16 @@ class DGCCompressor(Compressor):
         #: (a scatter-add no-op, SURVEY.md §2.5). The per-tensor oracle
         #: path ignores the flag (wire format, not numerics).
         self.packed_indices = packed_indices
+        #: opt-in payload integrity checksum (flat engine only,
+        #: resilience.integrity): one int32 wraparound word per size
+        #: bucket over the exact (value bits, index) wire words, shipped
+        #: on the existing index all-gather; every receiver recomputes
+        #: over the gathered payload and counts mismatching bucket rows
+        #: into the guard metrics (``checksum_failures``). Detection +
+        #: telemetry, not correction — the always-on index clamp already
+        #: bounds the blast radius of a corrupt index. Incompatible with
+        #: int8_values (the f32 scale wire would ride uncovered).
+        self.checksum = checksum
         if int8_values and fp16_values:
             raise ValueError("int8_values and fp16_values are mutually "
                              "exclusive wire formats")

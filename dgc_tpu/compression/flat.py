@@ -44,6 +44,8 @@ import numpy as np
 
 from dgc_tpu.compression.memory import DGCSGDMemory
 from dgc_tpu.ops import kernels
+from dgc_tpu.resilience import faults as _faults
+from dgc_tpu.resilience import integrity
 from dgc_tpu.utils.pytree import named_flatten, named_unflatten
 
 __all__ = ["ParamLayout", "FlatDGCEngine", "FlatDenseExchange"]
@@ -607,6 +609,23 @@ class FlatDGCEngine:
             self._codec = IndexCodec(self.buckets)
         else:
             self._codec = None
+        #: opt-in payload checksum (resilience.integrity): one int32 word
+        #: per bucket over the exact wire bits, shipped on the index
+        #: gather. Verified only when the caller passes ``health_out`` to
+        #: ``exchange`` (the guarded step does); the counter surfaces as
+        #: the ``checksum_failures`` guard metric.
+        self.checksum = (bool(getattr(compressor, "checksum", False))
+                         and self.payload_size > 0)
+        if self.checksum and self._row_map is not None:
+            raise ValueError(
+                "checksum=True is not supported with int8_values — the "
+                "per-row f32 scale wire would ride uncovered; use the "
+                "fp16/f32 value wire")
+        if self.checksum:
+            from dgc_tpu.resilience.integrity import bucket_segments
+            self._seg_ids = bucket_segments(self.buckets)
+        else:
+            self._seg_ids = None
         #: any bucket selects through the segment-top-2 kernel: the TPU
         #: compensate pass then emits the candidates itself
         #: (kernels.fused_compensate_bits_cands) instead of a standalone
@@ -1449,9 +1468,17 @@ class FlatDGCEngine:
     def exchange(self, flat_grad: jax.Array, mem: Dict, key: jax.Array,
                  axis_name: str, world_size: int, op: str = "average",
                  local_axis: Optional[str] = None, local_size: int = 1,
-                 telemetry: bool = False):
+                 telemetry: bool = False,
+                 health_out: Optional[Dict] = None):
         """compress -> communicate -> decompress over the whole model:
         two ``all_gather`` + one ``psum`` per step, total.
+
+        ``health_out`` — mutable out-param dict (the ``stats_out``
+        precedent from :meth:`sparsify`): with the engine's payload
+        checksum on, the receiver-side mismatch count lands under
+        ``"checksum_failures"`` (f32 scalar, identical on every worker —
+        a pure function of gathered data). None (the default) skips the
+        verification entirely; the guarded step passes a dict.
 
         ``telemetry=True`` additionally returns a third element: the
         per-step stat pytree of ``dgc_tpu.telemetry.registry.STEP_METRICS``
@@ -1632,15 +1659,63 @@ class FlatDGCEngine:
                            if self.c.fp16_values else values)
             g_values = jax.lax.all_gather(wire_values,
                                           axis_name)        # [W, payload]
+        if _faults.armed():
+            # deterministic post-gather corruption (tests only; identity
+            # ops, zero HLO, when DGC_FAULTS is unset)
+            g_values = _faults.corrupt_wire(g_values)
+        checksum = self.checksum and health_out is not None
+        if checksum:
+            # sender-side per-bucket checksum over the exact wire forms:
+            # the value words as shipped, and the indices in the form the
+            # receiver reconstructs (codec slots clip in-row — see
+            # IndexCodec.canonical). Rides the index gather below.
+            idx_canon = (self._codec.canonical(indices)
+                         if self._codec is not None else indices)
+            chk = integrity.payload_checksum(
+                wire_values, idx_canon, self._seg_ids, len(self.buckets))
         if self._codec is not None:
             # packed index wire: gather the bitstream, decode per worker
             # (static gathers + shifts; decoded == original for every
             # real slot, padded slots land in-row with value 0.0)
-            g_words = jax.lax.all_gather(self._codec.encode(indices),
-                                         axis_name)
+            words = self._codec.encode(indices)
+            if checksum:
+                # int32 -> uint32 astype is a bit-preserving mod-2^32
+                # wrap, undone symmetrically on the receiver
+                words = jnp.concatenate([words, chk.astype(jnp.uint32)])
+            g_words = jax.lax.all_gather(words, axis_name)
+            if checksum:
+                g_chk = g_words[:, self._codec.nwords:].astype(jnp.int32)
+                g_words = g_words[:, :self._codec.nwords]
             g_indices = self._codec.decode(g_words, self.index_dtype)
         else:
-            g_indices = jax.lax.all_gather(indices, axis_name)
+            idx_wire = indices
+            if checksum:
+                idx_wire = jnp.concatenate(
+                    [indices, chk.astype(self.index_dtype)])
+            g_idx_wire = jax.lax.all_gather(idx_wire, axis_name)
+            if checksum:
+                g_chk = g_idx_wire[:, self.payload_size:].astype(jnp.int32)
+                g_indices = g_idx_wire[:, :self.payload_size]
+            else:
+                g_indices = g_idx_wire
+        if _faults.armed():
+            g_indices = _faults.corrupt_indices(g_indices)
+        if checksum:
+            health_out["checksum_failures"] = integrity.count_mismatches(
+                g_values, g_indices, g_chk, self._seg_ids,
+                len(self.buckets))
+        # always-on bounds clamp BEFORE the scatter-add: XLA drops >= T
+        # indices under jit but wraps NEGATIVE ones python-style, so a
+        # corrupted payload word decoding to -5 would silently add
+        # garbage at T-5. Out-of-range indices route to the structural-
+        # zero sentinel slot (scatters there are no-ops by layout
+        # construction); the codec path additionally enforces each
+        # slot's static row bounds — exactly the set an honest encode
+        # can produce. Honest traffic passes through bitwise unchanged.
+        g_indices = integrity.clamp_indices(
+            g_indices, T, self.layout.sentinel,
+            *((self._codec.slot_off, self._codec.slot_numel)
+              if self._codec is not None else (None, None)))
         # Averaging divides the [W, payload] WIRE values BEFORE the
         # scatter (algebraically identical to the reference's
         # scatter-then-divide, compression.py:192-193; differs by
@@ -1826,7 +1901,10 @@ class FlatDenseExchange:
 
     def exchange(self, flat_grad, mem, key, axis_name, world_size,
                  op: str = "average", local_axis: Optional[str] = None,
-                 local_size: int = 1, telemetry: bool = False):
+                 local_size: int = 1, telemetry: bool = False,
+                 health_out: Optional[Dict] = None):
+        # health_out accepted for signature parity with FlatDGCEngine;
+        # the dense psum has no sparse payload to checksum
         if telemetry:
             # dense-baseline taps: grad norm only; no sparse payload, no
             # error-feedback state (wire_bytes is the SPARSE wire metric

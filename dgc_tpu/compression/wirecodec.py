@@ -93,6 +93,17 @@ class IndexCodec:
     def bits_per_index(self) -> float:
         return self.total_bits / self.payload if self.payload else 0.0
 
+    def canonical(self, indices: jax.Array) -> jax.Array:
+        """The ``decode(encode(x))`` fixed point: each index clipped into
+        its slot's owning row. This is what every receiver reconstructs
+        from the wire, so it is the form the sender-side payload checksum
+        (``resilience.integrity``) must cover — checksumming the raw
+        indices would flag every padded (sentinel-carrying) slot as a
+        mismatch."""
+        off = jnp.asarray(self.slot_off, indices.dtype)
+        hi_lim = jnp.asarray(self.slot_numel - 1, indices.dtype)
+        return off + jnp.clip(indices - off, 0, hi_lim)
+
     def encode(self, indices: jax.Array) -> jax.Array:
         """[payload] global flat indices -> [nwords] uint32 bitstream."""
         if not self.payload:

@@ -165,7 +165,11 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
         return named_unflatten(out, treedef), opt_state, mem_state
 
     def update_flat(self, flat_grads, opt_state, flat_params, mem_state,
-                    key, engine) -> Tuple[jax.Array, object, dict]:
+                    key, engine, telemetry: bool = False,
+                    health_out=None) -> Tuple[jax.Array, object, dict]:
+        if telemetry:
+            raise NotImplementedError(
+                "telemetry taps are not wired through the Adasum flat path")
         # local step FIRST (reference optimizer.py:267-275: the wrapped
         # optimizer advances on local gradients, producing the delta)
         updates, opt_state = self.optimizer.update(flat_grads, opt_state,
@@ -173,5 +177,5 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
         reduced, mem_state = engine.exchange(
             updates, mem_state, key, self.axis_name, self.num_nodes,
             op="adasum", local_axis=self.local_axis_name,
-            local_size=self.local_size)
+            local_size=self.local_size, health_out=health_out)
         return reduced, opt_state, mem_state

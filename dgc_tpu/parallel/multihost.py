@@ -17,16 +17,23 @@ Launchers in ``script/`` show the three standard entries: single host,
 
 import inspect
 import os
+import time
 from typing import Optional
 
 import jax
 
 __all__ = ["initialize_multihost", "is_coordinator", "local_batch_slice"]
 
+#: the env triple the launcher scripts export — set all three or none
+_ENV_TRIPLE = ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+               "JAX_PROCESS_ID")
+
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None,
+                         init_retries: int = 3,
+                         init_backoff: float = 1.0,
                          **timeouts) -> bool:
     """Call ``jax.distributed.initialize`` when running multi-host.
 
@@ -47,7 +54,20 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     default is shorter than a cold multi-minute XLA compile — the barrier
     then kills the healthy process with DEADLINE_EXCEEDED.
 
+    ``init_retries`` bounds retry of a failed
+    ``jax.distributed.initialize`` (coordinator not up yet — the common
+    race when workers of a pod/Slurm job start skewed), with exponential
+    backoff starting at ``init_backoff`` seconds. The last attempt's
+    error propagates.
+
     Returns True when distributed init ran, False for single-process runs.
+
+    **Fail-fast on a partial env triple**: exporting only some of
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` is always a launcher bug — half-configured, a run
+    would either hang waiting for processes that never dial in or
+    silently come up single-process. Raise immediately with the missing
+    names instead.
     """
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
@@ -63,6 +83,23 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     if process_id is None and "SLURM_PROCID" in os.environ:
         process_id = int(os.environ["SLURM_PROCID"])
 
+    # fail-fast on a half-wired coordinator setup: once ANY of the triple
+    # is supplied (args, env, or Slurm) the other two must resolve too —
+    # a partial triple either hangs the job waiting for workers that
+    # never dial in, or (num/id without a coordinator) silently comes up
+    # single-process and trains on a fraction of the data
+    resolved = {"JAX_COORDINATOR_ADDRESS": coordinator_address,
+                "JAX_NUM_PROCESSES": num_processes,
+                "JAX_PROCESS_ID": process_id}
+    missing = [k for k, v in resolved.items() if v is None]
+    if missing and len(missing) < len(resolved):
+        raise RuntimeError(
+            "partial multihost configuration: "
+            f"{sorted(set(resolved) - set(missing))} resolved but "
+            f"{missing} missing — export the full JAX_COORDINATOR_ADDRESS/"
+            "JAX_NUM_PROCESSES/JAX_PROCESS_ID triple (or none of it for "
+            "TPU-pod autodetection)")
+
     # TPU_WORKER_HOSTNAMES lists every host of a pod slice; a single entry
     # (no comma) is a one-host environment — nothing to wire up
     pod_hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
@@ -72,12 +109,34 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     if not multi:
         return False
     accepted = inspect.signature(jax.distributed.initialize).parameters
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **{k: v for k, v in timeouts.items() if k in accepted})
-    return True
+    kwargs = {k: v for k, v in timeouts.items() if k in accepted}
+    # bounded retry around the coordination-service dial-in: worker
+    # processes of a pod/Slurm job start skewed, and a worker that dials
+    # in before the coordinator is listening gets a connection error it
+    # should wait out, not die from. The fault-injection hook
+    # (DGC_FAULTS="init_fail@N") exercises exactly this path in tests.
+    from dgc_tpu.resilience import faults as _faults
+    last_err = None
+    for attempt in range(max(1, int(init_retries))):
+        try:
+            if _faults.should_fail_init(attempt):
+                raise RuntimeError(
+                    f"injected init failure (attempt {attempt})")
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs)
+            return True
+        except Exception as e:
+            last_err = e
+            if attempt + 1 >= max(1, int(init_retries)):
+                raise
+            delay = init_backoff * (2 ** attempt)
+            print(f"[multihost] initialize attempt {attempt + 1} failed "
+                  f"({type(e).__name__}: {e}); retrying in {delay:.1f}s")
+            time.sleep(delay)
+    raise last_err  # unreachable; keeps the control flow explicit
 
 
 def is_coordinator() -> bool:

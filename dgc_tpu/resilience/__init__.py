@@ -1,0 +1,24 @@
+"""Fault tolerance for the DGC training loop (docs/RESILIENCE.md).
+
+DGC's accuracy story depends on worker-local error-feedback state that is
+not recoverable from the model parameters (Lin et al., ICLR 2018): a lost
+or corrupted step silently diverges training. On preemptible pods the
+faults are routine — NaN gradient spikes, corrupted exchange payloads,
+SIGTERM preemptions, coordinator flakes, hung collectives. This package
+pairs every guard with a deterministic injector that triggers it in tests:
+
+* :mod:`guard` — in-graph, host-sync-free step guards (nonfinite-grad
+  skip + loss-spike circuit breaker); ``guards=None`` compiles away
+  byte-identically (contract-pinned in ``dgc_tpu.analysis.suite``).
+* :mod:`integrity` — sparse-exchange hardening: decoded-index clamping
+  before the scatter-add and an opt-in per-bucket payload checksum
+  (``DGCCompressor(checksum=True)``).
+* :mod:`preempt` — SIGTERM/SIGINT → emergency checkpoint + clean
+  distributed shutdown; watchdog thread for stalled steps.
+* :mod:`faults` — env-driven deterministic fault injection
+  (``DGC_FAULTS=nan@2,bitflip:elem=0:bit=18,...``).
+"""
+
+from dgc_tpu.resilience.guard import GuardConfig, init_state
+
+__all__ = ["GuardConfig", "init_state"]

@@ -1,0 +1,154 @@
+"""Deterministic, env-driven fault injection (docs/RESILIENCE.md).
+
+Every guard in this package ships with an injector that actually triggers
+it, so the resilience tests assert behavior instead of hoping. Faults are
+armed through one env var read at **trace/build time** (Python-static):
+
+    DGC_FAULTS="nan@2,bitflip:elem=0:bit=18,kill@5,init_fail@2"
+
+Comma-separated tokens, each ``kind[@step][:key=val]*``:
+
+* ``nan@K`` — poison the local gradient with NaN at train-step K (in-graph
+  ``jnp.where`` on the step counter; deterministic on every worker).
+* ``bitflip[:elem=I][:bit=B]`` — XOR bit B of gathered wire-value element
+  I inside the sparse exchange (post-gather, pre-apply) — the corruption
+  the payload checksum exists to catch.
+* ``badidx[:elem=I][:set=V]`` — overwrite gathered payload index I with V
+  (e.g. a negative or >T value) — the corruption the index clamp routes
+  to the structural-zero sentinel.
+* ``kill@K`` — host-side ``SIGTERM`` to the own process at step K (the
+  preemption drill for the kill-and-resume multiprocess test).
+* ``init_fail@N`` — the first N ``jax.distributed.initialize`` attempts
+  raise (exercises the bounded retry in ``parallel.multihost``).
+
+With ``DGC_FAULTS`` unset every hook is an identity at trace time: zero
+ops, zero HLO difference (the guards-off compile-away contract runs with
+faults unarmed). Unknown tokens raise — a typo'd fault plan silently not
+firing would make a green resilience test meaningless.
+"""
+
+import os
+import signal
+from typing import Dict, NamedTuple, Optional
+
+__all__ = ["FaultPlan", "plan", "armed", "inject_nan_grads", "corrupt_wire",
+           "corrupt_indices", "maybe_kill", "should_fail_init"]
+
+ENV = "DGC_FAULTS"
+
+
+class FaultPlan(NamedTuple):
+    nan_step: Optional[int] = None
+    kill_step: Optional[int] = None
+    init_failures: int = 0
+    bitflip: Optional[Dict[str, int]] = None
+    badidx: Optional[Dict[str, int]] = None
+
+
+def plan(spec: Optional[str] = None) -> FaultPlan:
+    """Parse the fault plan from ``spec`` or the ``DGC_FAULTS`` env var."""
+    if spec is None:
+        spec = os.environ.get(ENV, "")
+    nan_step = kill_step = None
+    init_failures = 0
+    bitflip = badidx = None
+    for tok in filter(None, (t.strip() for t in spec.split(","))):
+        parts = tok.split(":")
+        head, _, at = parts[0].partition("@")
+        params = {}
+        for p in parts[1:]:
+            k, _, v = p.partition("=")
+            params[k] = int(v)
+        if head == "nan":
+            nan_step = int(at)
+        elif head == "kill":
+            kill_step = int(at)
+        elif head == "init_fail":
+            init_failures = int(at)
+        elif head == "bitflip":
+            bitflip = {"elem": params.get("elem", 0),
+                       "bit": params.get("bit", 0)}
+        elif head == "badidx":
+            badidx = {"elem": params.get("elem", 0),
+                      "set": params.get("set", -1)}
+        else:
+            raise ValueError(f"unknown fault token {tok!r} in {ENV}")
+    return FaultPlan(nan_step, kill_step, init_failures, bitflip, badidx)
+
+
+def armed() -> bool:
+    return bool(os.environ.get(ENV))
+
+
+# ------------------------------------------------------------------ #
+# in-graph injectors (trace-time static: unarmed == identity)        #
+# ------------------------------------------------------------------ #
+
+def inject_nan_grads(grads, step):
+    """NaN-poison every float gradient leaf when ``step == nan_step``."""
+    p = plan()
+    if p.nan_step is None:
+        return grads
+    import jax
+    import jax.numpy as jnp
+
+    def poison(g):
+        if not (hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)):
+            return g
+        return jnp.where(step == p.nan_step,
+                         jnp.full_like(g, jnp.nan), g)
+
+    return jax.tree.map(poison, grads)
+
+
+def _flip_bit(x, bit):
+    import jax.numpy as jnp
+    from jax import lax
+    if x.dtype == jnp.float32:
+        return lax.bitcast_convert_type(
+            lax.bitcast_convert_type(x, jnp.int32) ^ jnp.int32(1 << bit),
+            jnp.float32)
+    if x.dtype == jnp.float16:
+        return lax.bitcast_convert_type(
+            lax.bitcast_convert_type(x, jnp.uint16)
+            ^ jnp.uint16(1 << (bit % 16)), jnp.float16)
+    return x ^ x.dtype.type(1 << bit)
+
+
+def corrupt_wire(g_values):
+    """XOR one bit of one gathered wire-value element (post-gather)."""
+    p = plan()
+    if p.bitflip is None or not g_values.size:
+        return g_values
+    flat = g_values.reshape(-1)
+    e = p.bitflip["elem"] % flat.shape[0]
+    return flat.at[e].set(_flip_bit(flat[e], p.bitflip["bit"])
+                          ).reshape(g_values.shape)
+
+
+def corrupt_indices(g_indices):
+    """Overwrite one gathered payload index (post-gather, pre-clamp)."""
+    p = plan()
+    if p.badidx is None or not g_indices.size:
+        return g_indices
+    import jax.numpy as jnp
+    flat = g_indices.reshape(-1)
+    e = p.badidx["elem"] % flat.shape[0]
+    return flat.at[e].set(jnp.asarray(p.badidx["set"], flat.dtype)
+                          ).reshape(g_indices.shape)
+
+
+# ------------------------------------------------------------------ #
+# host-side injectors                                                #
+# ------------------------------------------------------------------ #
+
+def maybe_kill(step: int) -> None:
+    """SIGTERM the own process at the armed step (preemption drill)."""
+    p = plan()
+    if p.kill_step is not None and int(step) == p.kill_step:
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def should_fail_init(attempt: int) -> bool:
+    """True while ``attempt`` (0-based) is within the armed failure count."""
+    return attempt < plan().init_failures

@@ -1,0 +1,103 @@
+"""Sparse-exchange integrity: index clamping + payload checksums.
+
+**Index clamp (always on).** The decompress scatter-add writes the
+gathered payload at gathered indices. XLA drops indices ``>= T`` under
+jit, but NEGATIVE indices wrap python-style — a corrupted payload word
+decoding to ``-5`` silently adds garbage at ``T-5``. On the packed-index
+wire a flipped bit lands the decode anywhere inside the slot's bit mask,
+possibly past the owning row. ``clamp_indices`` routes every out-of-range
+index to the engine's structural-zero sentinel slot (scatters there are
+no-ops by layout construction), with per-slot ROW bounds on the codec
+path. Honest traffic is bitwise unchanged: valid indices pass through.
+
+**Payload checksum (opt-in, ``DGCCompressor(checksum=True)``).** One
+int32 wraparound checksum per size bucket over the (value bits, index)
+words, computed on the sender over the exact wire forms and recomputed by
+every receiver over the gathered payload. The checksum words ride the
+existing index all-gather (concatenated), so the exchange stays at two
+gathers. Mismatch COUNTS surface through the guard metrics
+(``checksum_failures``) — detection + telemetry, not correction: the
+clamp already bounds the blast radius of a bad index, and a bad value is
+at worst one gradient contribution.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["clamp_indices", "bucket_segments", "payload_checksum",
+           "count_mismatches"]
+
+
+def clamp_indices(g_indices, total: int, sentinel: int,
+                  slot_off: Optional[np.ndarray] = None,
+                  slot_numel: Optional[np.ndarray] = None):
+    """Route out-of-range payload indices to the structural-zero sentinel.
+
+    ``g_indices`` is ``[..., payload]``. Without slot bounds the valid
+    range is ``[0, total)`` (the scatter operand extent); with the codec's
+    static per-slot ``(slot_off, slot_numel)`` each slot must land inside
+    its owning row — tighter, and exactly the set of values an honest
+    encode can produce."""
+    import jax.numpy as jnp
+    if slot_off is not None:
+        off = jnp.asarray(slot_off, g_indices.dtype)
+        lim = off + jnp.asarray(slot_numel, g_indices.dtype)
+        valid = (g_indices >= off) & (g_indices < lim)
+    else:
+        valid = (g_indices >= 0) & (g_indices < total)
+    return jnp.where(valid, g_indices,
+                     jnp.asarray(sentinel, g_indices.dtype))
+
+
+def bucket_segments(buckets) -> np.ndarray:
+    """Static payload-slot -> bucket-id map (payload order is bucket by
+    bucket, matching the engine's wire layout)."""
+    if not buckets:
+        return np.zeros(0, np.int32)
+    return np.concatenate([np.full(b.payload, i, np.int32)
+                           for i, b in enumerate(buckets)])
+
+
+def _bits32(x):
+    """Reinterpret wire values as int32 words (checksum domain): the
+    checksum must see the exact bits on the wire, not a float view that
+    maps 0.0 == -0.0 or treats NaNs as equal-nothing."""
+    import jax.numpy as jnp
+    from jax import lax
+    if x.dtype == jnp.float32:
+        return lax.bitcast_convert_type(x, jnp.int32)
+    if x.dtype == jnp.float16:
+        return lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.int32)
+    return x.astype(jnp.int32)
+
+
+def payload_checksum(values, indices, seg_ids: np.ndarray,
+                     num_buckets: int):
+    """Per-bucket int32 wraparound checksum over ``[payload]`` wire words.
+
+    Each slot contributes ``(value_bits XOR mixed_index) * odd_position``
+    — the position factor keeps two swapped entries from cancelling, the
+    Knuth-constant index mix keeps (value, index) pairs from colliding
+    with (index, value)."""
+    import jax
+    import jax.numpy as jnp
+    word = _bits32(values) ^ (indices.astype(jnp.int32)
+                              * jnp.int32(-1640531527))
+    pos = (jnp.arange(word.shape[-1], dtype=jnp.int32) << 1) | jnp.int32(1)
+    return jax.ops.segment_sum(word * pos, jnp.asarray(seg_ids),
+                               num_segments=num_buckets)
+
+
+def count_mismatches(g_values, g_indices, g_chk, seg_ids: np.ndarray,
+                     num_buckets: int):
+    """Recompute checksums over the gathered ``[W, payload]`` wire and
+    count bucket rows that disagree with the shipped ``[W, nb]`` words.
+    Deterministic and identical on every worker (pure function of gathered
+    data) — no collective needed to agree on the verdict."""
+    import jax
+    import jax.numpy as jnp
+    recomputed = jax.vmap(
+        lambda v, i: payload_checksum(v, i, seg_ids, num_buckets)
+    )(g_values, g_indices)
+    return jnp.sum((recomputed != g_chk).astype(jnp.float32))

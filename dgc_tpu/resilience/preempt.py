@@ -1,0 +1,156 @@
+"""Preemption handling, watchdog, and clean shutdown (HOST-side code).
+
+Everything here deliberately runs OUTSIDE the traced step — signal
+handlers, threads, and wall clocks are host concepts, and the analysis
+allowlist records them as audited exceptions to the dgclint host-sync
+rules. The contract with the hot loop is minimal:
+
+* :class:`PreemptionHandler` installs SIGTERM/SIGINT handlers that only
+  set a flag (async-signal-safe — no jax, no I/O in the handler). The
+  training loop polls ``handler.requested`` at step boundaries and runs
+  the emergency checkpoint itself, on its own thread, with the runtime in
+  a known-quiescent state.
+* :class:`Watchdog` is a daemon thread fed one ``beat()`` per step; after
+  ``timeout`` seconds of silence it dumps all thread stacks and flushes
+  the telemetry sink — diagnostics only, it never kills the run (a hung
+  DCN collective is for the job scheduler to reap; the stacks say WHERE
+  it hung).
+* :func:`agree_preempt` turns a host-local preemption flag into an
+  all-process verdict (one tiny gloo allgather) so a multi-process run
+  enters the collective emergency save on the same step boundary
+  everywhere. Cloud preemptions signal every worker; a test killing one
+  worker needs the agreement.
+"""
+
+import faulthandler
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["PreemptionHandler", "Watchdog", "agree_preempt",
+           "clean_shutdown"]
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT -> ``requested`` flag; the loop does the real work.
+
+    Usable as a context manager; ``uninstall()`` restores the previous
+    handlers. Must be constructed on the main thread (CPython restricts
+    ``signal.signal`` to it)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._prev = {}
+        for s in signals:
+            self._prev[s] = signal.signal(s, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self.requested = True
+        self.signum = signum
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+class Watchdog:
+    """Daemon thread that dumps stacks + flushes telemetry on a stalled
+    step. ``beat()`` once per step; silence past ``timeout`` seconds
+    triggers one dump, then the clock rearms (no spam while stalled).
+
+    ``sink`` — optional TelemetrySink (its ``flush()`` drains the async
+    queue so the last records hit disk before the scheduler reaps us).
+    ``on_stall`` — optional callback for tests/custom handling."""
+
+    def __init__(self, timeout: float, sink=None,
+                 on_stall: Optional[Callable[[], None]] = None,
+                 interval: Optional[float] = None, stream=None):
+        if timeout <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout}")
+        self.timeout = timeout
+        self.stalls = 0
+        self._sink = sink
+        self._on_stall = on_stall
+        self._stream = stream
+        self._interval = interval if interval is not None else max(
+            0.1, timeout / 4.0)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="dgc-watchdog", daemon=True)
+        self._thread.start()
+
+    def beat(self):
+        self._last = time.monotonic()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            if time.monotonic() - self._last <= self.timeout:
+                continue
+            self.stalls += 1
+            stream = self._stream or sys.stderr
+            try:
+                print(f"[watchdog] no step progress for >{self.timeout}s "
+                      "— thread stacks follow", file=stream, flush=True)
+                faulthandler.dump_traceback(file=stream, all_threads=True)
+            except Exception:
+                pass
+            try:
+                if self._sink is not None:
+                    self._sink.flush()
+            except Exception:
+                pass
+            if self._on_stall is not None:
+                try:
+                    self._on_stall()
+                except Exception:
+                    pass
+            self._last = time.monotonic()   # rearm
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def agree_preempt(local_flag: bool) -> bool:
+    """All-process OR of a host-local preemption flag. Call at a step
+    boundary on EVERY process (it is a collective); single-process runs
+    short-circuit with no communication."""
+    import jax
+    if jax.process_count() == 1:
+        return bool(local_flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([1.0 if local_flag else 0.0], np.float32))
+    return bool(np.sum(flags) > 0)
+
+
+def clean_shutdown() -> None:
+    """Best-effort distributed teardown: lets the coordinator drop this
+    process cleanly instead of waiting out a heartbeat timeout."""
+    import jax
+    try:
+        if jax.process_count() > 1:
+            jax.distributed.shutdown()
+    except Exception as e:    # already down / never initialized
+        print(f"[preempt] distributed shutdown skipped: {e}",
+              file=sys.stderr)

@@ -12,8 +12,9 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 __all__ = [
     "SCHEMA", "SCHEMA_VERSION", "MetricSpec", "STEP_METRICS", "RUN_METRICS",
-    "step_stat_names", "spec_by_name", "step_out_specs", "make_header",
-    "validate_step_stats",
+    "GUARD_METRICS", "step_stat_names", "guard_stat_names", "spec_by_name",
+    "step_out_specs", "guard_out_specs", "make_header",
+    "validate_step_stats", "validate_guard_stats",
 ]
 
 #: schema family tag written into every sink header
@@ -62,6 +63,23 @@ STEP_METRICS: Tuple[MetricSpec, ...] = (
                "bucket's real payload slots"),
 )
 
+#: guard counters emitted by the guarded step (dgc_tpu.resilience.guard)
+#: under the record key "guards". ADDITIVE to schema version 1: records
+#: carry these keys only when guards are on, and readers are key-generic
+#: (unknown record keys pass through), so no version bump — the header
+#: lists them under "guard_metrics" when present.
+GUARD_METRICS: Tuple[MetricSpec, ...] = (
+    MetricSpec("skipped_steps", "scalar",
+               "cumulative guard-skipped update count (nonfinite grads/"
+               "loss or loss-spike breaker)", better="lower"),
+    MetricSpec("nonfinite_rate", "scalar",
+               "fraction of guarded steps where any worker saw a "
+               "nonfinite gradient or loss", better="lower"),
+    MetricSpec("checksum_failures", "scalar",
+               "cumulative payload-checksum mismatches across the sparse "
+               "exchange (0 when the checksum is off)", better="lower"),
+)
+
 #: run-level summary keys the regression gate compares (step time and
 #: overhead come from bench records; wire volume from either source).
 RUN_METRICS: Tuple[MetricSpec, ...] = (
@@ -83,9 +101,13 @@ def step_stat_names() -> Tuple[str, ...]:
     return tuple(s.name for s in STEP_METRICS)
 
 
+def guard_stat_names() -> Tuple[str, ...]:
+    return tuple(s.name for s in GUARD_METRICS)
+
+
 def spec_by_name() -> Dict[str, MetricSpec]:
     seen: Dict[str, MetricSpec] = {}
-    for s in STEP_METRICS + RUN_METRICS:
+    for s in STEP_METRICS + GUARD_METRICS + RUN_METRICS:
         seen.setdefault(s.name, s)
     return seen
 
@@ -97,6 +119,13 @@ def step_out_specs(spec_fn):
     return {s.name: spec_fn() for s in STEP_METRICS}
 
 
+def guard_out_specs(spec_fn):
+    """Out-spec pytree for the step's guard-metrics aux output. Guard
+    counters are replicated by construction (pure functions of psum'd /
+    gathered data), so no pmean rides on them."""
+    return {s.name: spec_fn() for s in GUARD_METRICS}
+
+
 def validate_step_stats(stats: Dict) -> None:
     """Fail loudly when a tap emits a dict that drifts from the schema."""
     got, want = set(stats), set(step_stat_names())
@@ -106,11 +135,26 @@ def validate_step_stats(stats: Dict) -> None:
             f"missing={sorted(want - got)} extra={sorted(got - want)}")
 
 
-def make_header(static: Optional[Dict] = None) -> Dict:
-    """Versioned JSONL header row (first line of every sink file)."""
-    return {
+def validate_guard_stats(stats: Dict) -> None:
+    """Same drift check for the guard-metrics dict."""
+    got, want = set(stats), set(guard_stat_names())
+    if got != want:
+        raise ValueError(
+            f"guard stats drifted from the registry schema: "
+            f"missing={sorted(want - got)} extra={sorted(got - want)}")
+
+
+def make_header(static: Optional[Dict] = None,
+                guards: bool = False) -> Dict:
+    """Versioned JSONL header row (first line of every sink file).
+    ``guards=True`` additionally lists the guard columns the records will
+    carry — an additive key, readers of version 1 ignore it safely."""
+    header = {
         "schema": SCHEMA,
         "version": SCHEMA_VERSION,
         "metrics": [s._asdict() for s in STEP_METRICS],
         "static": dict(static or {}),
     }
+    if guards:
+        header["guard_metrics"] = [s._asdict() for s in GUARD_METRICS]
+    return header

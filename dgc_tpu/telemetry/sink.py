@@ -58,9 +58,11 @@ class TelemetrySink:
     """
 
     def __init__(self, path: str, static: Optional[Dict] = None,
-                 rotate_bytes: int = 64 << 20, enabled: bool = True):
+                 rotate_bytes: int = 64 << 20, enabled: bool = True,
+                 guards: bool = False):
         self.enabled = bool(enabled)
         self._static = dict(static or {})
+        self._guards = bool(guards)
         self._rotate_bytes = int(rotate_bytes)
         self._rotations = 0
         self._dropped = 0
@@ -134,7 +136,8 @@ class TelemetrySink:
 
     def _open_file(self, path: str) -> None:
         self._fh = open(path, "w")
-        self._fh.write(json.dumps(registry.make_header(self._static)) + "\n")
+        self._fh.write(json.dumps(
+            registry.make_header(self._static, guards=self._guards)) + "\n")
         self._fh.flush()
 
     def _maybe_rotate(self) -> None:

@@ -48,33 +48,42 @@ class CheckpointManager:
              topology: Optional[Dict[str, int]] = None) -> str:
         """Save epoch checkpoint, update latest pointer, rotate, track best.
 
+        **Atomic**: the state AND its meters.json are written to
+        ``e<N>.tmp`` and published with one ``os.rename`` — a crash or
+        preemption mid-write leaves only a ``.tmp`` directory that the
+        next run ignores (and ``restore`` falls back to the previous kept
+        epoch), never a half-written ``e<N>`` that latest.json points at.
+
         Multi-process (``jax.process_count() > 1``): EVERY process must
         call this with the same global (sharded) state — orbax coordinates
         the distributed array write itself (the directory must be a shared
         filesystem, as on TPU pods) — while all the filesystem bookkeeping
-        (meters/latest files, best copy, rotation) happens on the
+        (rename, meters/latest files, best copy, rotation) happens on the
         coordinator only, fenced by barriers so no process races a
         directory that is being rotated. Single-process keeps the simple
         host-materialized write."""
         multi = jax.process_count() > 1
         coord = jax.process_index() == 0
         path = self._epoch_dir(epoch)
+        tmp = path + ".tmp"
         if multi:
             from jax.experimental import multihost_utils
-            if coord and os.path.exists(path):
-                shutil.rmtree(path)
+            if coord and os.path.exists(tmp):   # stale from a crashed run
+                shutil.rmtree(tmp)
             multihost_utils.sync_global_devices(f"ckpt_pre_save_e{epoch}")
-            self._ckptr.save(path, state)      # collective: global arrays
+            self._ckptr.save(tmp, state)       # collective: global arrays
             self._ckptr.wait_until_finished()
             multihost_utils.sync_global_devices(f"ckpt_post_save_e{epoch}")
         else:
             host_state = jax.tree.map(np.asarray, jax.device_get(state))
-            if os.path.exists(path):
-                shutil.rmtree(path)
-            self._ckptr.save(path, host_state)
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            self._ckptr.save(tmp, host_state)
             self._ckptr.wait_until_finished()
         if coord:
-            with open(os.path.join(path, "meters.json"), "w") as f:
+            # meters.json goes INTO the tmp dir before the rename, so the
+            # published checkpoint is complete the instant it exists
+            with open(os.path.join(tmp, "meters.json"), "w") as f:
                 payload = {k: float(v) for k, v in meters.items()}
                 payload["epoch"] = epoch
                 if topology:
@@ -84,6 +93,9 @@ class CheckpointManager:
                     # silently reinterpret per-worker error-feedback state)
                     payload["_topology"] = dict(topology)
                 json.dump(payload, f)
+            if os.path.exists(path):           # same-epoch overwrite
+                shutil.rmtree(path)
+            os.rename(tmp, path)
             with open(self._meta_path(), "w") as f:
                 json.dump({"epoch": epoch}, f)
             if best:
@@ -150,8 +162,23 @@ class CheckpointManager:
     def latest_epoch(self) -> Optional[int]:
         if not os.path.exists(self._meta_path()):
             return None
-        with open(self._meta_path()) as f:
-            return int(json.load(f)["epoch"])
+        try:
+            with open(self._meta_path()) as f:
+                return int(json.load(f)["epoch"])
+        except (ValueError, KeyError, OSError):
+            # torn/corrupt pointer (crash mid-write): restore() falls back
+            # to scanning the kept epoch directories
+            return None
+
+    def _kept_epochs(self) -> list:
+        """Epoch numbers of the on-disk ``e<N>`` checkpoint dirs, newest
+        first (``.tmp`` staging dirs and ``best`` excluded)."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("e") and name[1:].isdigit() \
+                    and os.path.isdir(os.path.join(self.directory, name)):
+                out.append(int(name[1:]))
+        return sorted(out, reverse=True)
 
     def restore(self, template: Any, epoch: Optional[int] = None,
                 best: bool = False,
@@ -165,20 +192,62 @@ class CheckpointManager:
         tier config), a mismatch raises an explicit error BEFORE the
         restore instead of failing deep inside orbax/XLA with an opaque
         sharding message.
+
+        When no explicit ``epoch`` is given and the newest checkpoint is
+        corrupt (crash mid-write before atomic saves, truncated array
+        files, unreadable meters), restore **falls back** to the previous
+        kept epochs, newest first, instead of silently training from
+        scratch while good checkpoints sit on disk. A topology mismatch is
+        a configuration error, not corruption — it raises immediately.
         """
         if best:
             path = os.path.join(self.directory, "best")
             if not os.path.exists(path):
                 return None
-            epoch = -1
+            try:
+                return self._restore_one(path, -1, template, topology,
+                                         best=True)
+            except RuntimeError:
+                raise
+            except Exception as e:
+                print(f"[checkpoint] incompatible checkpoint at {path}, "
+                      f"ignoring: {self._errline(e)}")
+                return None
+        if epoch is not None:
+            candidates = [epoch]
         else:
-            if epoch is None:
-                epoch = self.latest_epoch()
-            if epoch is None:
-                return None
-            path = self._epoch_dir(epoch)
+            latest = self.latest_epoch()
+            candidates = self._kept_epochs()
+            if latest is not None:
+                candidates = [latest] + [e for e in candidates if e != latest]
+        for i, ep in enumerate(candidates):
+            path = self._epoch_dir(ep)
             if not os.path.exists(path):
-                return None
+                continue
+            try:
+                return self._restore_one(path, ep, template, topology,
+                                         best=False)
+            except RuntimeError:
+                raise                     # topology mismatch: config error
+            except Exception as e:
+                more = any(os.path.exists(self._epoch_dir(x))
+                           for x in candidates[i + 1:])
+                print(f"[checkpoint] incompatible checkpoint at {path}, "
+                      f"ignoring: {self._errline(e)}"
+                      + (" — falling back to the previous kept epoch"
+                         if more else ""))
+        return None
+
+    @staticmethod
+    def _errline(e: Exception) -> str:
+        s = str(e).splitlines()
+        return s[0] if s else type(e).__name__
+
+    def _restore_one(self, path: str, epoch: int, template: Any,
+                     topology: Optional[Dict[str, int]], best: bool
+                     ) -> Tuple[Any, int, Dict[str, float]]:
+        """Restore one checkpoint directory or raise (the public
+        ``restore`` turns failures into kept-epoch fallback)."""
         saved_topology = None
         meters_path = os.path.join(path, "meters.json")
         if os.path.exists(meters_path):
@@ -191,6 +260,29 @@ class CheckpointManager:
                 f"{saved_topology} but this run has {dict(topology)} — "
                 "resume with the same process/mesh/tier configuration, or "
                 "start a fresh experiment directory")
+        try:
+            state = self._restore_state(path, template)
+        except Exception:
+            if getattr(template, "guards", None) is None:
+                raise
+            # pre-resilience checkpoint (no guard-counter subtree): retry
+            # without it — the caller re-seeds fresh guard state rather
+            # than discarding an otherwise-good checkpoint
+            state = self._restore_state(path, template.replace(guards=None))
+            print(f"[checkpoint] {path} predates the resilience guard "
+                  "counters — they start fresh")
+        meters: Dict[str, float] = {}
+        if os.path.exists(meters_path):
+            with open(meters_path) as f:
+                meters = json.load(f)
+        meters.pop("_topology", None)
+        if best:
+            epoch = int(meters.pop("epoch", epoch))
+        else:
+            meters.pop("epoch", None)
+        return state, epoch, meters
+
+    def _restore_state(self, path: str, template: Any) -> Any:
         if jax.process_count() > 1:
             # restore straight into the live sharded layout: global arrays
             # cannot be host-materialized per process, and the sharding on
@@ -215,69 +307,51 @@ class CheckpointManager:
             return state
 
         try:
-            try:
-                state = _restore_checked(host_template)
-            except ValueError:
-                # legacy engine-memory migrations, newest first. The
-                # deferred-mask state was a full-[T] f32 keep MASK in v0.2
-                # ('keep_c', 1.0 = keep) and a transmit COUNT in v0.3
-                # ('sent_c', 0.0 = keep); v0.4 packs it into int32 words
-                # ('sent_bits', kernels.pack_sent_bits). Retry with each
-                # legacy key and convert, so old runs resume instead of
-                # silently restarting — pending deferred masks survive the
-                # conversion exactly. (Multi-process restores skip the
-                # shape-changing migrations: the legacy leaf would need a
-                # sharding the template cannot supply.)
-                if jax.process_count() > 1:
-                    if self._legacy_sent_template(host_template,
-                                                  "sent_c") is not None:
-                        # don't leave only the generic "incompatible,
-                        # ignoring" line: a legacy checkpoint IS
-                        # recoverable, just not from here — the operator
-                        # should migrate it before the multi-process run
-                        # silently restarts from scratch
-                        print("[checkpoint] NOTE: this may be a legacy "
-                              "(v0.2/v0.3) memory layout, which cannot be "
-                              "migrated under multi-process restore; run a "
-                              "single-process restore+save once to migrate "
-                              "it, then resume multi-process")
+            state = _restore_checked(host_template)
+        except ValueError:
+            # legacy engine-memory migrations, newest first. The
+            # deferred-mask state was a full-[T] f32 keep MASK in v0.2
+            # ('keep_c', 1.0 = keep) and a transmit COUNT in v0.3
+            # ('sent_c', 0.0 = keep); v0.4 packs it into int32 words
+            # ('sent_bits', kernels.pack_sent_bits). Retry with each
+            # legacy key and convert, so old runs resume instead of
+            # silently restarting — pending deferred masks survive the
+            # conversion exactly. (Multi-process restores skip the
+            # shape-changing migrations: the legacy leaf would need a
+            # sharding the template cannot supply.)
+            if jax.process_count() > 1:
+                if self._legacy_sent_template(host_template,
+                                              "sent_c") is not None:
+                    # don't leave only the generic "incompatible,
+                    # ignoring" line: a legacy checkpoint IS
+                    # recoverable, just not from here — the operator
+                    # should migrate it before the multi-process run
+                    # silently restarts from scratch
+                    print("[checkpoint] NOTE: this may be a legacy "
+                          "(v0.2/v0.3) memory layout, which cannot be "
+                          "migrated under multi-process restore; run a "
+                          "single-process restore+save once to migrate "
+                          "it, then resume multi-process")
+                raise
+            state = None
+            for key, to_transmitted in (
+                    ("sent_c", lambda s: np.asarray(s) != 0.0),
+                    ("keep_c", lambda k: np.asarray(k) == 0.0)):
+                legacy = self._legacy_sent_template(host_template, key)
+                if legacy is None:
                     raise
-                state = None
-                for key, to_transmitted in (
-                        ("sent_c", lambda s: np.asarray(s) != 0.0),
-                        ("keep_c", lambda k: np.asarray(k) == 0.0)):
-                    legacy = self._legacy_sent_template(host_template, key)
-                    if legacy is None:
-                        raise
-                    try:
-                        state = _restore_checked(legacy)
-                    except ValueError:
-                        continue
-                    mem = dict(state.memory)
-                    bits = self._pack_transmitted_np(
-                        to_transmitted(mem.pop(key)))
-                    mem["sent_bits"] = bits
-                    state = state.replace(memory=mem)
-                    print(f"[checkpoint] migrated legacy {key} record at "
-                          f"{path}")
-                    break
-                if state is None:
-                    raise ValueError("no legacy memory layout matched")
-        except ValueError as e:
-            # on-disk structure from an older/incompatible state layout
-            # (e.g. per-tensor vs flat buffers): train from scratch rather
-            # than crash — the reference likewise starts fresh when resume
-            # files are absent (train.py:154-165)
-            print(f"[checkpoint] incompatible checkpoint at {path}, "
-                  f"ignoring: {str(e).splitlines()[0]}")
-            return None
-        meters = {}
-        if os.path.exists(meters_path):
-            with open(meters_path) as f:
-                meters = json.load(f)
-        meters.pop("_topology", None)
-        if best:
-            epoch = int(meters.pop("epoch", epoch))
-        else:
-            meters.pop("epoch", None)
-        return state, epoch, meters
+                try:
+                    state = _restore_checked(legacy)
+                except ValueError:
+                    continue
+                mem = dict(state.memory)
+                bits = self._pack_transmitted_np(
+                    to_transmitted(mem.pop(key)))
+                mem["sent_bits"] = bits
+                state = state.replace(memory=mem)
+                print(f"[checkpoint] migrated legacy {key} record at "
+                      f"{path}")
+                break
+            if state is None:
+                raise ValueError("no legacy memory layout matched")
+        return state

@@ -31,6 +31,11 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     memory: Any
     batch_stats: Any
+    #: step-guard counters/window (dgc_tpu.resilience.guard), replicated;
+    #: None (the default) is an EMPTY pytree — a guards-off state has
+    #: exactly the pre-resilience leaf structure, so old checkpoints
+    #: restore unchanged and the guards-off step compiles byte-identically
+    guards: Any = None
 
 
 def with_leading_axis(tree: Any, world_size: int) -> Any:
@@ -60,6 +65,7 @@ def state_specs(state: TrainState, axis="data",
                                state.opt_state),
         memory=jax.tree.map(lambda _: P(axis), state.memory),
         batch_stats=jax.tree.map(lambda _: P(axis), state.batch_stats),
+        guards=jax.tree.map(lambda _: P(), state.guards),
     )
 
 

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dgc_tpu.ops import kernels
 from dgc_tpu.optim.distributed import DistributedOptimizer
+from dgc_tpu.resilience import faults as _faults
 from dgc_tpu.training.state import TrainState, state_specs, with_leading_axis
 from dgc_tpu.utils.compat import shard_map
 
@@ -58,20 +59,31 @@ def make_flat_setup(variables, dist_opt: DistributedOptimizer) -> FlatSetup:
 
 
 def make_flat_state(variables, dist_opt: DistributedOptimizer,
-                    setup: FlatSetup, world_size: int) -> TrainState:
+                    setup: FlatSetup, world_size: int,
+                    guards=None) -> TrainState:
     """Initial flat TrainState (params/opt replicated; memory and BN stats
-    per-worker with a leading [world] axis, as in ``dgc_tpu.training.state``)."""
+    per-worker with a leading [world] axis, as in ``dgc_tpu.training.state``).
+
+    ``guards`` — a ``resilience.guard.GuardConfig`` to carry guard
+    counters in the state (pass the SAME config to
+    :func:`build_train_step`); None keeps the pre-resilience pytree."""
     flat_params = setup.layout.flatten(variables["params"])
     flat_stats = setup.stats_layout.flatten(variables.get("batch_stats", {}))
     opt_state = dist_opt.init(flat_params)
     if dist_opt.per_worker_opt_state:
         opt_state = with_leading_axis(opt_state, world_size)
+    if guards is not None:
+        from dgc_tpu.resilience import guard as _guard
+        gstate = _guard.init_state(guards)
+    else:
+        gstate = None
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=flat_params,
         opt_state=opt_state,
         memory=with_leading_axis(setup.engine.init_memory(), world_size),
-        batch_stats=with_leading_axis(flat_stats, world_size))
+        batch_stats=with_leading_axis(flat_stats, world_size),
+        guards=gstate)
 
 
 def _squeeze0(tree):
@@ -113,7 +125,8 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                      mesh: Mesh, num_batches_per_step: int = 1,
                      use_dropout: bool = False, donate: bool = True,
                      flat: Optional[FlatSetup] = None,
-                     model_dtype=None, telemetry: bool = False):
+                     model_dtype=None, telemetry: bool = False,
+                     guards=None):
     """Build the jitted data-parallel DGC train step.
 
     Returns ``step_fn(state, images, labels, key) -> (state, metrics)`` where
@@ -149,10 +162,33 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     dispatches; feed it to :class:`dgc_tpu.telemetry.sink.TelemetrySink`.
     The default ``False`` traces none of it, leaving the compiled step
     byte-identical to the pre-telemetry program.
+
+    ``guards`` (flat path only): a ``resilience.guard.GuardConfig``
+    enabling the in-graph step guards — nonfinite-grad/loss detection and
+    the loss-spike circuit breaker, both skipping the WHOLE update
+    atomically (params, optimizer state, DGC momentum + residual, and BN
+    stats revert; only the step counter advances). The state must carry
+    guard counters (``make_flat_state(..., guards=cfg)``) and the metrics
+    dict gains a ``"guards"`` pytree
+    (``telemetry.registry.GUARD_METRICS``). Zero extra collectives: the
+    per-worker badness flag rides the existing loss psum as a stacked
+    ``[2]`` vector, and the skip is a traced select — no host syncs. The
+    default None compiles the guards away byte-identically (contract-
+    pinned in ``dgc_tpu.analysis.suite``).
     """
     if telemetry and flat is None:
         raise ValueError("telemetry taps require the flat engine path "
                          "(pass flat=make_flat_setup(...))")
+    if guards is not None and flat is None:
+        raise ValueError("step guards require the flat engine path "
+                         "(pass flat=make_flat_setup(...))")
+    if (flat is not None and getattr(flat.engine, "checksum", False)
+            and guards is None):
+        raise ValueError(
+            "DGCCompressor(checksum=True) needs guards= on the step "
+            "builder — the mismatch counter travels in the guard metrics")
+    if guards is not None:
+        from dgc_tpu.resilience import guard as _guard
     loss_fn = make_loss_fn(apply_fn)
     world = dist_opt.world_size
     axes = dist_opt.data_axes      # (axis,) flat, (hosts, local) two-tier
@@ -168,15 +204,20 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         pack_grads = layout.flatten
         pack_stats = stats_layout.flatten
 
+        want_health = (guards is not None
+                       and getattr(engine, "checksum", False))
+
         def do_update(grads, params, opt_state, memory, key):
+            health = {} if want_health else None
             if telemetry:
                 upd, opt_state, memory, tstats = dist_opt.update_flat(
                     grads, opt_state, params, memory, key, engine,
-                    telemetry=True)
-                return params + upd, opt_state, memory, tstats
+                    telemetry=True, health_out=health)
+                return params + upd, opt_state, memory, tstats, health
             upd, opt_state, memory = dist_opt.update_flat(
-                grads, opt_state, params, memory, key, engine)
-            return params + upd, opt_state, memory, None
+                grads, opt_state, params, memory, key, engine,
+                health_out=health)
+            return params + upd, opt_state, memory, None, health
     else:
         unpack_params = unpack_stats = pack_grads = pack_stats = (
             lambda x: x)
@@ -184,7 +225,8 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         def do_update(grads, params, opt_state, memory, key):
             upd, opt_state, memory = dist_opt.update(
                 grads, opt_state, params, memory, key)
-            return optax.apply_updates(params, upd), opt_state, memory, None
+            return (optax.apply_updates(params, upd), opt_state, memory,
+                    None, None)
 
     per_worker_opt = dist_opt.per_worker_opt_state
 
@@ -274,24 +316,56 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                 return (gsum, pack_stats(new_stats), losssum + lval,
                         i + 1), None
 
+        stats0, memory0 = packed_stats, memory
         zeros = jax.tree.map(jnp.zeros_like, state.params)
         (grads, packed_stats, loss, _), _ = jax.lax.scan(
             micro, (zeros, packed_stats, jnp.zeros((), jnp.float32),
                     jnp.zeros((), jnp.int32)),
             (mb_images, mb_labels))
+        if _faults.armed():
+            # deterministic NaN injection at the armed step (tests only;
+            # identity — zero ops — when DGC_FAULTS is unset)
+            grads = _faults.inject_nan_grads(grads, state.step)
 
-        opt_state = (_squeeze0(state.opt_state) if per_worker_opt
-                     else state.opt_state)
-        new_params, opt_state, memory, tstats = do_update(
-            grads, state.params, opt_state, memory, sparsify_key)
+        opt_state0 = (_squeeze0(state.opt_state) if per_worker_opt
+                      else state.opt_state)
+        new_params, opt_state, memory, tstats, health = do_update(
+            grads, state.params, opt_state0, memory, sparsify_key)
 
-        mean_loss = jax.lax.psum(loss, axes) / world
+        if guards is not None:
+            # the per-worker badness flag rides the loss all-reduce as a
+            # stacked [2] vector — same collective count as unguarded,
+            # and every worker computes the identical verdict
+            bad_local = _guard.nonfinite_flag(grads, loss)
+            packed = jax.lax.psum(jnp.stack([loss, bad_local]), axes)
+            mean_loss = packed[0] / world
+            bad_count = packed[1]
+        else:
+            mean_loss = jax.lax.psum(loss, axes) / world
         metrics = {"loss": mean_loss}
         if telemetry:
             # per-worker stats -> replicated (mesh mean), matching the
             # loss: the collective rides the same program (no dispatch)
             from dgc_tpu.telemetry import taps
             metrics["telemetry"] = taps.pmean_stats(tstats, axes)
+
+        if guards is not None:
+            skip, gstate, gmetrics = _guard.apply(
+                guards, state.guards, bad_count=bad_count,
+                mean_loss=mean_loss,
+                checksum_failures=(health or {}).get("checksum_failures"))
+            # ATOMIC skip: every piece of the update reverts together —
+            # params, optimizer state, DGC momentum + residual (the
+            # exchange's memory write included), and BN stats. A partial
+            # revert would silently desynchronize the error-feedback
+            # residual from the transmit record. Step counter advances.
+            new_params = _guard.tree_select(skip, state.params, new_params)
+            opt_state = _guard.tree_select(skip, opt_state0, opt_state)
+            memory = _guard.tree_select(skip, memory0, memory)
+            packed_stats = _guard.tree_select(skip, stats0, packed_stats)
+            metrics["guards"] = gmetrics
+        else:
+            gstate = state.guards
 
         new_state = TrainState(
             step=state.step + 1,
@@ -300,6 +374,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                        else opt_state),
             memory=_expand0(memory),
             batch_stats=_expand0(packed_stats),
+            guards=gstate,
         )
         return new_state, metrics
 
@@ -307,6 +382,9 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     if telemetry:
         from dgc_tpu.telemetry import registry
         metric_specs["telemetry"] = registry.step_out_specs(P)
+    if guards is not None:
+        from dgc_tpu.telemetry import registry
+        metric_specs["guards"] = registry.guard_out_specs(P)
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step_fn(state, images, labels, key):

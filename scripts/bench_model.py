@@ -55,6 +55,13 @@ def main():
                          "taps' overhead (ISSUE 2 gate: <= 1% of step "
                          "time). Both arms consume their metric outputs "
                          "so nothing is dead-code-eliminated.")
+    ap.add_argument("--guards-ab", action="store_true",
+                    help="pair dgc+guards(+checksum) against plain dgc: "
+                         "measures the resilience layer's in-graph cost "
+                         "(nonfinite skip + spike breaker + payload "
+                         "checksum; docs/RESILIENCE.md). Both arms "
+                         "consume their metric outputs so nothing is "
+                         "dead-code-eliminated.")
     ap.add_argument("--telemetry-out", default=None,
                     help="write a telemetry JSONL run summary (sink "
                          "schema) for the regression gate: python -m "
@@ -110,38 +117,45 @@ def main():
             return state, m["loss"]
         return run
 
-    def prepare(dist, telemetry=False, consume=False):
+    def prepare(dist, telemetry=False, consume=False, guards=None):
         setup = make_flat_setup(v, dist)
-        state = shard_state(make_flat_state(v, dist, setup, W), mesh,
+        state = shard_state(make_flat_state(v, dist, setup, W,
+                                            guards=guards), mesh,
                             dist_opt=dist)
         step = build_train_step(model.apply, dist, mesh, donate=dispatch,
                                 use_dropout="vgg" in args.model,
                                 flat=setup,
                                 model_dtype=(jnp.bfloat16 if args.bf16
                                              else None),
-                                telemetry=telemetry)
+                                telemetry=telemetry, guards=guards)
         loop = (make_dispatch_loop(step, args.k) if dispatch
                 else bench._make_k_loop(step, images, labels, args.k,
                                         consume_metrics=consume))
         return (loop, state), setup
 
-    def mk_comp():
+    def mk_comp(checksum=False):
         c = DGCCompressor(args.ratio, memory=DGCSGDMemory(
             momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8,
             int8_error_feedback=not args.no_int8_ef,
-            fused_apply=args.fused_apply)
+            fused_apply=args.fused_apply, checksum=checksum)
         c.initialize((n, p) for n, p in named.items() if p.ndim > 1)
         return c
 
-    def mk_dgc_dist():
+    def mk_dgc_dist(checksum=False):
         return DistributedOptimizer(
-            dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), mk_comp(),
-            world_size=W)
+            dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4),
+            mk_comp(checksum), world_size=W)
 
     if args.telemetry_ab:
         a_run, setup = prepare(mk_dgc_dist(), telemetry=True, consume=True)
         b_run, _ = prepare(mk_dgc_dist(), telemetry=False, consume=True)
         label = ("dgc+telemetry", "dgc")
+    elif args.guards_ab:
+        from dgc_tpu.resilience import GuardConfig
+        a_run, setup = prepare(mk_dgc_dist(checksum=True), consume=True,
+                               guards=GuardConfig(spike_window=8))
+        b_run, _ = prepare(mk_dgc_dist(), consume=True)
+        label = ("dgc+guards", "dgc")
     else:
         a_run, setup = prepare(mk_dgc_dist())
         b_run, _ = prepare(DistributedOptimizer(

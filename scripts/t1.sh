@@ -17,6 +17,13 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "TELEMETRY_SMOKE=ok" || { echo "TELEMETRY_SMOKE=FAIL"; rc=1; }
+# resilience smoke (docs/RESILIENCE.md): one guarded+checksummed train run
+# under simultaneous NaN and bit-flip injection — the nan step must skip
+# atomically, the checksum must count every corrupted exchange, and
+# training must stay finite
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "RESILIENCE_SMOKE=ok" || { echo "RESILIENCE_SMOKE=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite — nonzero on any un-allowlisted finding
 # or broken step invariant (one sparse exchange, telemetry compiles away,

@@ -84,3 +84,70 @@ def test_two_process_train_save_resume(tmp_path):
     # telemetry taps ran inside the cross-process program and agree
     assert results[0]["t4_payload"] == results[1]["t4_payload"] > 0
     assert (tmp_path / "ckpt_tt" / "latest.json").exists()
+
+
+def _run_pair(worker, tmp_path, phase, extra_env=None):
+    """Launch one 2-process phase of the preempt worker; return the parsed
+    per-process RESULT dicts."""
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DGC_FAULTS")}
+    logs = [open(tmp_path / f"{phase}_w{i}.log", "w+") for i in range(2)]
+    procs = []
+    for i in range(2):
+        e = dict(env)
+        if extra_env and i in extra_env:
+            e.update(extra_env[i])
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(i), "2", coord, str(tmp_path),
+             phase],
+            stdout=logs[i], stderr=subprocess.STDOUT, text=True, env=e))
+    outs = []
+    for p, lf in zip(procs, logs):
+        p.wait(timeout=1500)
+        lf.seek(0)
+        outs.append(lf.read())
+        lf.close()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"{phase} proc {i} failed:\n{out[-4000:]}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT:"):
+                r = json.loads(line[len("RESULT:"):])
+                results[r["proc"]] = r
+    assert set(results) == {0, 1}, f"{phase}: missing RESULT lines"
+    return results
+
+
+def test_kill_and_resume_bitwise_memory(tmp_path):
+    """Resilience drill (docs/RESILIENCE.md): SIGTERM one worker of a
+    2-process run mid-training; both processes must agree on the same step
+    boundary, write one collective emergency checkpoint, and exit cleanly.
+    A fresh launch must restore it and continue with BITWISE-identical
+    per-worker compressor memory and the exact loss trajectory of an
+    uninterrupted run."""
+    import signal
+
+    worker = os.path.join(os.path.dirname(__file__), "preempt_worker.py")
+    base = _run_pair(worker, tmp_path, "baseline")
+    run = _run_pair(worker, tmp_path, "run",
+                    extra_env={1: {"DGC_FAULTS": "kill@3"}})
+    res = _run_pair(worker, tmp_path, "resume")
+    for p in (0, 1):
+        # both processes broke on the same boundary, after exactly 3 steps
+        assert run[p]["preempt_at"] == 2
+        assert run[p]["losses"] == base[p]["losses"][:3]
+        # the emergency checkpoint holds the exact 3-step memory: saved,
+        # restored, and baseline fingerprints all bitwise-identical
+        assert (res[p]["mem_restored"] == run[p]["mem_saved"]
+                == base[p]["mem_at_kill"])
+        # post-resume trajectory matches the uninterrupted run exactly
+        assert res[p]["start"] == 3
+        assert res[p]["losses"] == base[p]["losses"][3:]
+        assert res[p]["mem_final"] == base[p]["mem_final"]
+    # only the faulted process saw the signal; the save was atomic (no
+    # .tmp staging dir left behind, latest pointer published)
+    assert run[1]["signum"] == int(signal.SIGTERM)
+    assert not (tmp_path / "ckpt_preempt" / "e0.tmp").exists()
+    assert (tmp_path / "ckpt_preempt" / "latest.json").exists()

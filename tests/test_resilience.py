@@ -1,0 +1,505 @@
+"""Resilience layer (docs/RESILIENCE.md): in-graph step guards, exchange
+integrity, fault injection, preemption handling, and checkpoint fallback.
+
+Every guard is asserted against the injector that triggers it
+(``DGC_FAULTS``) — behavior, not hope. Faults parse at trace time, so
+tests arm the env var (monkeypatch) BEFORE the first step call.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu.resilience import GuardConfig, faults, guard, integrity, preempt
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _updating_state(s):
+    return (s.params, s.opt_state, s.memory, s.batch_stats)
+
+
+# ---------------------------------------------------------------------- #
+# fault plan parsing                                                     #
+# ---------------------------------------------------------------------- #
+
+def test_fault_plan_grammar():
+    p = faults.plan("nan@2, bitflip:elem=3:bit=7, kill@5, init_fail@2, "
+                    "badidx:elem=1:set=-4")
+    assert p.nan_step == 2 and p.kill_step == 5 and p.init_failures == 2
+    assert p.bitflip == {"elem": 3, "bit": 7}
+    assert p.badidx == {"elem": 1, "set": -4}
+    assert faults.plan("") == faults.FaultPlan()
+    with pytest.raises(ValueError, match="unknown fault token"):
+        faults.plan("tyop@3")
+
+
+def test_armed_tracks_env(monkeypatch):
+    monkeypatch.delenv(faults.ENV, raising=False)
+    assert not faults.armed()
+    monkeypatch.setenv(faults.ENV, "nan@0")
+    assert faults.armed()
+
+
+# ---------------------------------------------------------------------- #
+# guard matrix: nonfinite skip, spike breaker                            #
+# ---------------------------------------------------------------------- #
+
+def test_nan_guard_skips_exactly_one_update(mesh8, monkeypatch):
+    """NaN gradients at step 1 must skip that update ATOMICALLY — params,
+    optimizer state, compressor memory, and BN stats all bitwise-unchanged
+    — while the step counter advances and training resumes next step."""
+    monkeypatch.setenv(faults.ENV, "nan@1")
+    from dgc_tpu.analysis.suite import build_fixture
+    state, step, _, (im, lb, key) = build_fixture(
+        mesh8, donate=False, guards=GuardConfig())
+
+    state1, m1 = step(state, im, lb, key)          # step 0: clean
+    assert float(m1["guards"]["skipped_steps"]) == 0.0
+    pre = jax.device_get(_updating_state(state1))
+
+    state2, m2 = step(state1, im, lb, key)         # step 1: poisoned
+    post = jax.device_get(_updating_state(state2))
+    assert _tree_equal(pre, post), "skip must revert the update bitwise"
+    assert int(state2.step) == 2, "the step counter still advances"
+    assert float(m2["guards"]["skipped_steps"]) == 1.0
+    assert float(m2["guards"]["nonfinite_rate"]) == pytest.approx(0.5)
+
+    state3, m3 = step(state2, im, lb, key)         # step 2: clean again
+    assert not _tree_equal(jax.device_get(state2.params),
+                           jax.device_get(state3.params))
+    assert float(m3["guards"]["skipped_steps"]) == 1.0
+    assert float(m3["guards"]["nonfinite_rate"]) == pytest.approx(1 / 3)
+    assert np.isfinite(np.asarray(jax.device_get(state3.params)).sum())
+
+
+def test_guards_off_step_has_no_guard_metrics(mesh8, monkeypatch):
+    monkeypatch.delenv(faults.ENV, raising=False)
+    from dgc_tpu.analysis.suite import build_fixture
+    state, step, _, (im, lb, key) = build_fixture(mesh8, donate=False)
+    _, m = step(state, im, lb, key)
+    assert "guards" not in m
+    assert state.guards is None
+
+
+def test_spike_breaker_window_semantics():
+    """The circuit breaker arms only once the window is full, trips on
+    loss > factor x window-mean, and spiked losses still enter the window
+    (a persistent level shift re-arms the baseline instead of skipping
+    forever). Nonfinite losses never pollute the window."""
+    cfg = GuardConfig(nonfinite=False, spike_window=2, spike_factor=2.0)
+    gs = guard.init_state(cfg)
+    zero = jnp.zeros(())
+
+    def run(losses):
+        nonlocal gs
+        skips = []
+        for v in losses:
+            skip, gs, _ = guard.apply(cfg, gs, bad_count=zero,
+                                      mean_loss=jnp.asarray(float(v)))
+            skips.append(bool(skip))
+        return skips
+
+    # warm-up (not armed), then a 10x spike trips, then recovery passes
+    assert run([1.0, 1.0, 10.0, 1.0]) == [False, False, True, False]
+    # the spike pushed into the window: mean is now (10+1)/2, so a
+    # persistent level shift to ~5 no longer trips once absorbed
+    assert run([5.0]) == [False]
+    # nonfinite loss: no skip from the breaker (nonfinite=False here) and
+    # no window pollution
+    before = np.asarray(gs["loss_window"]).copy()
+    assert run([float("nan")]) == [False]
+    np.testing.assert_array_equal(np.asarray(gs["loss_window"]), before)
+
+
+def test_nonfinite_guard_counts_bad_workers():
+    cfg = GuardConfig(nonfinite=True)
+    gs = guard.init_state(cfg)
+    skip, gs, m = guard.apply(cfg, gs, bad_count=jnp.asarray(1.0),
+                              mean_loss=jnp.asarray(1.0))
+    assert bool(skip) and float(m["skipped_steps"]) == 1.0
+    skip, gs, m = guard.apply(cfg, gs, bad_count=jnp.asarray(0.0),
+                              mean_loss=jnp.asarray(1.0))
+    assert not bool(skip) and float(m["skipped_steps"]) == 1.0
+
+
+def test_guard_config_validation():
+    with pytest.raises(ValueError):
+        GuardConfig(spike_window=-1)
+    with pytest.raises(ValueError):
+        GuardConfig(spike_window=4, spike_factor=1.0)
+
+
+# ---------------------------------------------------------------------- #
+# exchange integrity: index clamp + payload checksum                     #
+# ---------------------------------------------------------------------- #
+
+def test_scatter_add_wraps_negative_indices():
+    """The hazard the clamp exists for: JAX scatter-add DROPS indices >= T
+    but WRAPS negative ones — a corrupt negative index silently writes
+    into a live parameter slot."""
+    acc = jnp.zeros((4,), jnp.float32).at[jnp.asarray([-1])].add(
+        jnp.asarray([1.0]))
+    assert float(acc[3]) == 1.0          # wrote param slot 3, silently
+    acc = jnp.zeros((4,), jnp.float32).at[jnp.asarray([99])].add(
+        jnp.asarray([1.0]))
+    assert float(np.asarray(acc).sum()) == 0.0   # >=T at least drops
+
+
+def test_clamp_indices_matches_numpy_oracle():
+    total, sentinel = 100, 7
+    idx = jnp.asarray([-3, 0, 5, 99, 100, 10**6, -1], jnp.int32)
+    got = np.asarray(integrity.clamp_indices(idx, total, sentinel))
+    arr = np.asarray(idx)
+    want = np.where((arr >= 0) & (arr < total), arr, sentinel)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_clamp_indices_per_slot_bounds():
+    # codec layout: 4 payload slots, two owning rows [0,4) and [4,10);
+    # bounds arrays are per PAYLOAD slot, broadcast over the last axis
+    slot_off = np.asarray([0, 0, 4, 4], np.int32)
+    slot_numel = np.asarray([4, 4, 6, 6], np.int32)
+    idx = jnp.asarray([3, 5, 5, 12], jnp.int32)
+    got = np.asarray(integrity.clamp_indices(idx, 10, 0,
+                                             slot_off, slot_numel))
+    # 3 in [0,4) ok; 5 escapes row 0 -> sentinel; 5 in [4,10) ok;
+    # 12 past row 1 -> sentinel
+    np.testing.assert_array_equal(got, [3, 0, 5, 0])
+
+
+def test_payload_checksum_roundtrip_and_detection():
+    rng = np.random.RandomState(0)
+    nb, per = 3, 8
+    seg = np.repeat(np.arange(nb, dtype=np.int32), per)
+    vals = jnp.asarray(rng.randn(nb * per).astype(np.float32))
+    idx = jnp.asarray(rng.randint(0, 1000, nb * per).astype(np.int32))
+    chk = integrity.payload_checksum(vals, idx, seg, nb)
+    # symmetric recompute: zero mismatches on an intact payload
+    g_vals, g_idx = vals[None], idx[None]
+    g_chk = chk[None]
+    assert float(integrity.count_mismatches(
+        g_vals, g_idx, g_chk, seg, nb)) == 0.0
+    # one flipped mantissa bit in one value -> exactly one bucket flags
+    bad = np.asarray(vals).copy()
+    bad[5] = np.frombuffer(
+        (np.asarray(bad[5]).view(np.int32) ^ (1 << 18)).tobytes(),
+        np.float32)[0]
+    assert float(integrity.count_mismatches(
+        jnp.asarray(bad)[None], g_idx, g_chk, seg, nb)) == 1.0
+    # a corrupted index flags too (the checksum covers both words)
+    bad_idx = np.asarray(idx).copy()
+    bad_idx[9] += 1
+    assert float(integrity.count_mismatches(
+        g_vals, jnp.asarray(bad_idx)[None], g_chk, seg, nb)) == 1.0
+
+
+def test_checksum_refused_with_int8_values():
+    from dgc_tpu.analysis.suite import build_fixture
+    with pytest.raises(ValueError, match="int8"):
+        build_fixture(None, donate=False, guards=GuardConfig(),
+                      compressor_kwargs={"checksum": True,
+                                         "int8_values": True})
+
+
+def test_checksum_requires_guards(mesh8):
+    from dgc_tpu.analysis.suite import build_fixture
+    with pytest.raises(ValueError, match="guards"):
+        build_fixture(mesh8, donate=False,
+                      compressor_kwargs={"checksum": True})
+
+
+def test_checksum_counts_injected_bitflip(mesh8, monkeypatch):
+    monkeypatch.setenv(faults.ENV, "bitflip:elem=0:bit=18")
+    from dgc_tpu.analysis.suite import build_fixture
+    state, step, _, (im, lb, key) = build_fixture(
+        mesh8, donate=False, guards=GuardConfig(),
+        compressor_kwargs={"checksum": True})
+    state, m = step(state, im, lb, key)
+    assert float(m["guards"]["checksum_failures"]) >= 1.0
+    state, m = step(state, im, lb, key)    # cumulative counter
+    assert float(m["guards"]["checksum_failures"]) >= 2.0
+
+
+def test_checksum_clean_run_counts_zero(mesh8, monkeypatch):
+    monkeypatch.delenv(faults.ENV, raising=False)
+    from dgc_tpu.analysis.suite import build_fixture
+    state, step, _, (im, lb, key) = build_fixture(
+        mesh8, donate=False, guards=GuardConfig(),
+        compressor_kwargs={"checksum": True})
+    for i in range(2):
+        state, m = step(state, im, lb, jax.random.fold_in(key, i))
+        assert float(m["guards"]["checksum_failures"]) == 0.0
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_bad_index_clamped_not_crashing(mesh8, monkeypatch):
+    """A corrupt (negative) gathered index routes to the structural-zero
+    sentinel instead of wrapping into a live parameter slot: training
+    stays finite and the checksum reports the corruption."""
+    monkeypatch.setenv(faults.ENV, "badidx:elem=0:set=-5")
+    from dgc_tpu.analysis.suite import build_fixture
+    state, step, _, (im, lb, key) = build_fixture(
+        mesh8, donate=False, guards=GuardConfig(),
+        compressor_kwargs={"checksum": True})
+    for i in range(2):
+        state, m = step(state, im, lb, jax.random.fold_in(key, i))
+    assert np.isfinite(np.asarray(jax.device_get(state.params)).sum())
+    assert float(m["guards"]["checksum_failures"]) >= 1.0
+
+
+# ---------------------------------------------------------------------- #
+# checkpoint: atomic publish + corrupt-latest fallback                   #
+# ---------------------------------------------------------------------- #
+
+def _ckpt_state(value: float):
+    from dgc_tpu.training import TrainState
+    return TrainState(
+        step=jnp.asarray(int(value), jnp.int32),
+        params={"w": jnp.full((4,), value)},
+        opt_state=(jnp.zeros(()),),
+        memory={"momentums": {"a/b": jnp.full((3,), value)}},
+        batch_stats={})
+
+
+def test_atomic_save_leaves_no_tmp(tmp_path):
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    # a stale staging dir from a crashed run must not block the save
+    os.makedirs(tmp_path / "e0.tmp")
+    mgr.save(0, _ckpt_state(1.0), {"m": 1.0})
+    assert not (tmp_path / "e0.tmp").exists()
+    # meters.json published atomically WITH the state
+    assert (tmp_path / "e0" / "meters.json").exists()
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path, capsys):
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, _ckpt_state(1.0), {"m": 0.5})
+    mgr.save(1, _ckpt_state(2.0), {"m": 1.5})
+    # corrupt the newest checkpoint: keep the dir, gut the array data
+    for name in os.listdir(tmp_path / "e1"):
+        if name != "meters.json":
+            p = tmp_path / "e1" / name
+            if p.is_dir():
+                import shutil
+                shutil.rmtree(p)
+            else:
+                p.unlink()
+    out = mgr.restore(_ckpt_state(0.0))
+    assert out is not None, "must fall back to the previous kept epoch"
+    state, epoch, meters = out
+    assert epoch == 0 and meters["m"] == 0.5
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 1.0)
+    assert "falling back" in capsys.readouterr().out
+
+
+def test_restore_falls_back_when_latest_dir_deleted(tmp_path):
+    import shutil
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, _ckpt_state(1.0), {})
+    mgr.save(1, _ckpt_state(2.0), {})
+    shutil.rmtree(tmp_path / "e1")      # latest.json still points at e1
+    out = mgr.restore(_ckpt_state(0.0))
+    assert out is not None
+    assert out[1] == 0
+
+
+def test_restore_survives_corrupt_latest_pointer(tmp_path):
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, _ckpt_state(3.0), {})
+    with open(tmp_path / "latest.json", "w") as f:
+        f.write("{torn wr")           # crash mid-write
+    assert mgr.latest_epoch() is None
+    out = mgr.restore(_ckpt_state(0.0))
+    assert out is not None and out[1] == 0
+    np.testing.assert_allclose(np.asarray(out[0].params["w"]), 3.0)
+
+
+def test_restore_resets_guards_for_pre_resilience_checkpoint(tmp_path,
+                                                            capsys):
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, _ckpt_state(1.0), {})          # saved WITHOUT guard state
+    template = _ckpt_state(0.0).replace(
+        guards=guard.init_state(GuardConfig()))
+    out = mgr.restore(template)
+    assert out is not None, "old checkpoints must restore under guards"
+    assert out[0].guards is None               # caller re-seeds fresh
+    np.testing.assert_allclose(np.asarray(out[0].params["w"]), 1.0)
+    assert "guard" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------- #
+# multihost: partial-triple fail-fast + bounded init retry               #
+# ---------------------------------------------------------------------- #
+
+def _clear_multihost_env(monkeypatch):
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID", "SLURM_NTASKS", "SLURM_PROCID",
+              "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_partial_env_triple_fails_fast(monkeypatch):
+    from dgc_tpu.parallel.multihost import initialize_multihost
+    _clear_multihost_env(monkeypatch)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+    with pytest.raises(RuntimeError, match="JAX_NUM_PROCESSES"):
+        initialize_multihost()
+    # num/id without a coordinator would silently come up single-process
+    _clear_multihost_env(monkeypatch)
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    with pytest.raises(RuntimeError, match="JAX_COORDINATOR_ADDRESS"):
+        initialize_multihost()
+
+
+def test_full_triple_passes_failfast_and_single_host_skips(monkeypatch):
+    from dgc_tpu.parallel.multihost import initialize_multihost
+    _clear_multihost_env(monkeypatch)
+    assert initialize_multihost() is False     # nothing set: single host
+
+
+def test_init_retry_recovers_from_transient_failures(monkeypatch):
+    from dgc_tpu.parallel import multihost
+    _clear_multihost_env(monkeypatch)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.setenv(faults.ENV, "init_fail@2")   # first 2 attempts die
+    calls = []
+
+    def stub(coordinator_address=None, num_processes=None, process_id=None,
+             **kw):
+        calls.append((coordinator_address, num_processes, process_id))
+
+    monkeypatch.setattr(jax.distributed, "initialize", stub)
+    assert multihost.initialize_multihost(
+        init_retries=3, init_backoff=0.0) is True
+    assert calls == [("127.0.0.1:1234", 1, 0)]      # 3rd attempt landed
+
+
+def test_init_retry_exhaustion_raises(monkeypatch):
+    from dgc_tpu.parallel import multihost
+    _clear_multihost_env(monkeypatch)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.setenv(faults.ENV, "init_fail@9")
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    with pytest.raises(RuntimeError, match="injected init failure"):
+        multihost.initialize_multihost(init_retries=2, init_backoff=0.0)
+    assert calls == []
+
+
+# ---------------------------------------------------------------------- #
+# preemption handler + watchdog (host-side)                              #
+# ---------------------------------------------------------------------- #
+
+def test_preemption_handler_sets_flag_and_restores():
+    prev = signal.getsignal(signal.SIGUSR1)
+    h = preempt.PreemptionHandler(signals=(signal.SIGUSR1,))
+    assert not h.requested
+    os.kill(os.getpid(), signal.SIGUSR1)
+    assert h.requested and h.signum == signal.SIGUSR1
+    h.uninstall()
+    assert signal.getsignal(signal.SIGUSR1) is prev
+
+
+def test_agree_preempt_single_process_short_circuits():
+    assert preempt.agree_preempt(True) is True
+    assert preempt.agree_preempt(False) is False
+
+
+def test_watchdog_detects_stall_and_flushes(tmp_path):
+    events = []
+
+    class FakeSink:
+        def flush(self):
+            events.append("flush")
+
+    with open(tmp_path / "wd.log", "w") as fh:
+        wd = preempt.Watchdog(0.3, sink=FakeSink(),
+                              on_stall=lambda: events.append("stall"),
+                              interval=0.05, stream=fh)
+        time.sleep(1.0)              # no beats: at least one stall fires
+        wd.stop()
+    assert wd.stalls >= 1
+    assert "stall" in events and "flush" in events
+    with open(tmp_path / "wd.log") as fh:
+        assert "no step progress" in fh.read()
+
+
+def test_watchdog_quiet_while_beating(tmp_path):
+    with open(tmp_path / "wd.log", "w") as fh:
+        wd = preempt.Watchdog(0.5, interval=0.05, stream=fh)
+        for _ in range(10):
+            wd.beat()
+            time.sleep(0.05)
+        wd.stop()
+    assert wd.stalls == 0
+
+
+def test_watchdog_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        preempt.Watchdog(0.0)
+
+
+# ---------------------------------------------------------------------- #
+# fast end-to-end smoke (scripts/t1.sh RESILIENCE_SMOKE)                 #
+# ---------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_resilience_smoke_guarded_faulted_run(mesh8, monkeypatch,
+                                              tmp_path):
+    """One guarded+checksummed fixture under simultaneous NaN and bit-flip
+    injection: the NaN step skips atomically, the checksum counts every
+    corrupted exchange, training stays finite throughout, and an
+    emergency-style save/restore resumes with the guard counters (and the
+    rest of the state) bitwise intact."""
+    monkeypatch.setenv(faults.ENV, "nan@2,bitflip:elem=0:bit=18")
+    from dgc_tpu.analysis.suite import build_fixture
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    state, step, _, (im, lb, key) = build_fixture(
+        mesh8, donate=False, guards=GuardConfig(),
+        compressor_kwargs={"checksum": True})
+    m = None
+    for i in range(4):
+        state, m = step(state, im, lb, jax.random.fold_in(key, i))
+    g = {k: float(v) for k, v in m["guards"].items()}
+    assert g["skipped_steps"] == 1.0           # exactly the nan@2 step
+    assert g["checksum_failures"] >= 4.0       # every exchange corrupted
+    assert g["nonfinite_rate"] == pytest.approx(0.25)
+    assert np.isfinite(float(m["loss"]))
+    assert np.isfinite(np.asarray(jax.device_get(state.params)).sum())
+
+    # emergency checkpoint + resume: the batch cursor round-trips and the
+    # restored state (guard counters included) is bitwise the saved one
+    ckpt = CheckpointManager(str(tmp_path), keep=2)
+    ckpt.save(0, state, {"preempt_batch": 3})
+    out = ckpt.restore(state)
+    assert out is not None and int(out[2]["preempt_batch"]) == 3
+    r_state = out[0]
+    assert _tree_equal(jax.device_get((state.params, state.memory,
+                                       state.guards)),
+                       jax.device_get((r_state.params, r_state.memory,
+                                       r_state.guards)))
+    r_state, m = step(r_state, im, lb, jax.random.fold_in(key, 4))
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["guards"]["skipped_steps"]) == 1.0

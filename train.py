@@ -19,6 +19,7 @@ TPU-native differences by design:
 """
 
 import argparse
+import itertools
 import os
 import time
 
@@ -227,14 +228,33 @@ def main():
         decay=decay,
         schedule_lr_per_epoch=configs.train.schedule_lr_per_epoch)
 
+    # resilience layer (configs/resilience.py, docs/RESILIENCE.md): in-graph
+    # step guards + exchange checksum ride the jitted step; preemption
+    # handling and the watchdog are host-side and installed further down
+    rcfg = configs.train.get("resilience", None)
+    res_on = bool(rcfg and rcfg.get("enabled", False))
+    guards_cfg = None
+    if res_on:
+        from dgc_tpu.resilience import GuardConfig
+        guards_cfg = GuardConfig(
+            nonfinite=bool(rcfg.get("nonfinite_guard", True)),
+            spike_window=int(rcfg.get("spike_window", 0) or 0),
+            spike_factor=float(rcfg.get("spike_factor", 10.0)))
+    res_checksum = bool(res_on and rcfg.get("checksum", False))
+
     printr(f'\n==> creating compression "{configs.train.compression}"')
     if configs.train.dgc:
         printr("\n==> initializing dgc compression")
         memory = configs.train.compression.memory()
-        compression = configs.train.compression(memory=memory, verbose=True)
+        compression = configs.train.compression(
+            memory=memory, verbose=True,
+            **({"checksum": True} if res_checksum else {}))
         compression.initialize(
             (n, p) for n, p in named_params.items() if p.ndim > 1)
     else:
+        if res_checksum:
+            raise SystemExit("--train.resilience.checksum needs the sparse "
+                             "DGC wire (configs with train.dgc = True)")
         compression = configs.train.compression()
 
     # optimize_bn_separately: BN params get weight_decay 0 (train.py:121-125).
@@ -256,7 +276,8 @@ def main():
         local_size=num_local)
 
     flat_setup = make_flat_setup(variables, dist)
-    state = shard_state(make_flat_state(variables, dist, flat_setup, world),
+    state = shard_state(make_flat_state(variables, dist, flat_setup, world,
+                                        guards=guards_cfg),
                         mesh, axis, dist_opt=dist)
 
     # resume from checkpoint (reference train.py:152-165); the topology
@@ -268,8 +289,16 @@ def main():
     last_epoch, best_metric = -1, None
     restored = ckpt.restore(state, best=args.evaluate, topology=topology) if (
         ckpt.latest_epoch() is not None or args.evaluate) else None
+    resume_epoch, resume_batch = None, 0
     if restored is not None:
         host_state, last_epoch, meters = restored
+        if guards_cfg is not None and host_state.guards is None:
+            # pre-resilience checkpoint: re-seed fresh guard counters
+            # (deterministic zeros — identical on every process)
+            from dgc_tpu.resilience import guard as _guard
+            host_state = host_state.replace(
+                guards=jax.tree.map(np.asarray,
+                                    _guard.init_state(guards_cfg)))
         if jax.process_count() > 1:
             # multi-host restore already produced global sharded arrays
             # placed by the template's shardings — no re-shard possible
@@ -279,7 +308,17 @@ def main():
             state = shard_state(jax.tree.map(jnp.asarray, host_state), mesh,
                                 axis, dist_opt=dist)
         best_metric = meters.get(configs.train.metric + "_best")
-        printr(f"\n[resumed] epoch {last_epoch}, best {best_metric}")
+        # an emergency (preemption) checkpoint records the IN-PROGRESS
+        # epoch and the last completed batch index: resume re-enters that
+        # epoch at the exact next batch instead of replaying it
+        pb = meters.get("preempt_batch")
+        if pb is not None:
+            resume_epoch, resume_batch = last_epoch, int(pb) + 1
+            last_epoch -= 1
+            printr(f"\n[resumed] mid-epoch {resume_epoch} "
+                   f"at batch {resume_batch}, best {best_metric}")
+        else:
+            printr(f"\n[resumed] epoch {last_epoch}, best {best_metric}")
     else:
         printr("\n==> train from scratch")
 
@@ -332,15 +371,35 @@ def main():
             static=dict(flat_setup.engine.telemetry_static(),
                         world=world, num_local_workers=num_local),
             rotate_bytes=int(tcfg.get("rotate_mb", 64)) << 20,
-            enabled=jax.process_index() == 0)
+            enabled=jax.process_index() == 0,
+            guards=guards_cfg is not None)
         printr(f"[telemetry] -> {sink.path or '(non-coordinator)'}")
+
+    # host-side resilience: signal -> flag (the loop does the emergency
+    # save at a step boundary); watchdog dumps stacks on a stalled step
+    handler = watchdog = None
+    if res_on:
+        from dgc_tpu.resilience import faults as _faults
+        from dgc_tpu.resilience import preempt as _preempt
+        handler = _preempt.PreemptionHandler()
+        wd_secs = float(rcfg.get("watchdog_secs", 0) or 0)
+        if wd_secs > 0:
+            watchdog = _preempt.Watchdog(wd_secs, sink=sink)
+        printr(f"[resilience] guards={guards_cfg} checksum={res_checksum} "
+               f"watchdog={wd_secs or 'off'}")
 
     ############
     # Training #
     ############
 
     step_fn = None
-    num_inputs = (last_epoch + 1) * steps_per_epoch * global_batch
+    num_inputs = ((last_epoch + 1) * steps_per_epoch
+                  + resume_batch) * global_batch
+    # python-side completed-step counter (kill-fault drill only; the real
+    # step counter lives on device in state.step — int() there would sync)
+    gstep = (last_epoch + 1) * steps_per_epoch + resume_batch
+    preempted = False
+    preempt_at = -1
     for epoch in range(last_epoch + 1, configs.train.num_epochs):
         printr(f"\n==> training epoch {epoch}/{configs.train.num_epochs}")
 
@@ -356,7 +415,8 @@ def main():
                                        use_dropout=use_dropout,
                                        flat=flat_setup,
                                        model_dtype=_narrow_model_dtype(model),
-                                       telemetry=telemetry_on)
+                                       telemetry=telemetry_on,
+                                       guards=guards_cfg)
             if sink is not None:
                 # engine geometry changes with the warm-up ratio: record
                 # it so readers can re-anchor the per-bucket columns
@@ -382,14 +442,30 @@ def main():
             # one-ahead async device transfer: the host assembles batch
             # k+1 and its host->device copy is in flight while the device
             # runs step k
-            batches = Prefetcher(ds, epoch_batches(
+            # mid-epoch (preemption) resume: skip the batches the
+            # interrupted run already consumed — the shuffle is a pure
+            # function of (epoch, seed), so the sequence lines up exactly
+            bofs = resume_batch if epoch == resume_epoch else 0
+            epoch_iter = epoch_batches(
                 len(ds), global_batch, epoch=epoch, seed=seed,
-                drop_last=nbps > 1))
+                drop_last=nbps > 1)
+            if bofs:
+                epoch_iter = itertools.islice(epoch_iter, bofs, None)
+            batches = Prefetcher(ds, epoch_iter)
             staged = stage_ahead(
                 batches,
                 lambda b: (host_local_to_global(b[0], mesh),
                            host_local_to_global(b[1], mesh)))
-            for bidx, (images, labels) in enumerate(staged):
+            for rel_idx, (images, labels) in enumerate(staged):
+                bidx = bofs + rel_idx
+                # preemption check at the step boundary: agree_preempt is
+                # a (tiny, host-side) collective on multi-process runs, so
+                # every process takes the emergency-save path on the SAME
+                # step — a lone worker breaking out would hang the rest
+                if handler is not None and _preempt.agree_preempt(
+                        handler.requested):
+                    preempted, preempt_at = True, bidx - 1
+                    break
                 state, metrics = step_fn(state, images, labels,
                                          jax.random.fold_in(
                                              base_key, epoch * 100003 + bidx))
@@ -400,10 +476,19 @@ def main():
                         jax.profiler.stop_trace()
                 seen += 1
                 num_inputs += global_batch
+                gstep += 1
+                if watchdog is not None:
+                    watchdog.beat()
+                if res_on and _faults.armed():
+                    _faults.maybe_kill(gstep)
                 if sink is not None and bidx % telem_every == 0:
                     # device arrays enqueued as-is: the sink's drain
-                    # thread does the (blocking) device->host transfer
-                    sink.write(num_inputs, metrics["telemetry"])
+                    # thread does the (blocking) device->host transfer;
+                    # guard counters ride the same record (key-additive)
+                    stats = metrics["telemetry"]
+                    if guards_cfg is not None:
+                        stats = {**stats, **metrics["guards"]}
+                    sink.write(num_inputs, stats)
                 logged = bidx % 50 == 0
                 if logged:
                     # keep the device scalar: float() here would block the
@@ -415,6 +500,8 @@ def main():
                 batches.close()
             if profile_left:         # epoch shorter than the trace window
                 jax.profiler.stop_trace()
+        if preempted:
+            break
         dt = time.time() - t0
         if metrics is None:
             printr("[warn] epoch produced no batches "
@@ -440,9 +527,29 @@ def main():
         path = ckpt.save(epoch, state, meters, best=best, topology=topology)
         printr(f"[save_path] = {path}")
 
+    if preempted:
+        # emergency checkpoint: full state (compressor memory included) +
+        # the in-progress epoch and last completed batch, so resume picks
+        # up at the exact next batch. All processes reach here on the same
+        # step (agree_preempt), so the collective save lines up.
+        printr(f"\n[preempt] signal {handler.signum}: stopping at "
+               f"epoch {epoch}, batch {preempt_at}")
+        if bool(rcfg.get("emergency_checkpoint", True)):
+            emeters = {"preempt_batch": preempt_at}
+            if best_metric is not None:
+                emeters[configs.train.metric + "_best"] = best_metric
+            path = ckpt.save(epoch, state, emeters, topology=topology)
+            printr(f"[preempt] emergency checkpoint -> {path}")
+
     if sink is not None:
         sink.close()
     writer.close()
+    if watchdog is not None:
+        watchdog.stop()
+    if handler is not None:
+        handler.uninstall()
+    if preempted:
+        _preempt.clean_shutdown()
 
 
 if __name__ == "__main__":
