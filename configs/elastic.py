@@ -1,0 +1,36 @@
+"""Elastic-restart knob (docs/RESILIENCE.md §"Elastic restart"): append to
+any config stack so a resume may land on a DIFFERENT world size than the
+checkpoint was written under:
+
+    python scripts/supervise.py -- python train.py \
+        --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/resilience.py configs/elastic.py
+
+What it enables (equivalently: the ``--elastic`` train.py flag):
+* the experiment directory drops its per-world suffix (``.npE`` instead
+  of ``.np<world>``), so every topology of the run shares one checkpoint
+  lineage;
+* a world-size mismatch at restore resharding the per-worker ``[world]``
+  state instead of failing fast — error-feedback residuals and momentum
+  accumulators are merged by summation (mass-exact) or split
+  one-inherits/rest-zero; BN stats are mean-reduced
+  (``dgc_tpu.resilience.elastic``);
+* degraded-mode batch geometry — a shrunk cohort raises
+  ``num_batches_per_step`` so the global batch and the scaled LR are
+  preserved exactly (set ``preserve_global_batch = False`` to accept the
+  changed geometry instead).
+
+Without this module (and without ``--elastic``) restore stays fail-fast,
+and the ``elastic-off-compiles-away`` contract in
+``dgc_tpu/analysis/suite.py`` pins that the compiled step is
+byte-identical either way — elastic is purely host-side restore logic.
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.elastic = Config()
+configs.train.elastic.enabled = True
+# preserve global batch + LR across world-size changes by scaling
+# num_batches_per_step inversely with the world size (raises on
+# non-divisible changes); False accepts the changed batch geometry
+configs.train.elastic.preserve_global_batch = True

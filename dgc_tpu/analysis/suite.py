@@ -19,6 +19,10 @@ tests exercise:
   resilience/guard or resilience/preempt code); guards=on (+ checksum)
   adds ZERO collectives — the bad-worker verdict rides the existing loss
   all-reduce and the checksum words ride the existing index all-gather.
+* **elastic restart is free when off**: elastic resharding is restore-
+  time host code — a step whose batch geometry went through
+  ``resolve_batch_geometry`` (identity) is byte-identical to the plain
+  build, and no ``resilience/elastic`` code ever lowers into the step.
 * **f32 end-to-end**: no f64 tensor type in any variant.
 * **trace stability**: same-shape calls never retrace.
 * **shard_state stays collective-free** (source contract): the
@@ -209,6 +213,21 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         collectives_delta=(plain, {"all-reduce": 0, "all-gather": 0}),
         no_f64=True)
     run(gon.name, gon.check)
+
+    # elastic=False must cost nothing: resharding lives entirely in the
+    # restore path (resilience/elastic.py is host numpy), so a step built
+    # after the elastic batch-geometry resolution (an identity here — the
+    # world size did not change) is byte-identical to the plain build and
+    # lowers zero elastic code
+    from dgc_tpu.resilience.elastic import resolve_batch_geometry
+    nbps_resolved, _note = resolve_batch_geometry(8, 8, 1)
+    _, step_ela, _, _ = build_fixture(mesh, donate=False, telemetry=False,
+                                      num_batches_per_step=nbps_resolved)
+    ela = _step_contract(
+        "elastic-off-compiles-away", state, step_ela, inputs,
+        forbid_substrings=["resilience/elastic"],
+        identical_to=plain)
+    run(ela.name, ela.check)
 
     _, step_don, _, _ = build_fixture(mesh, donate=True)
     don = _step_contract(

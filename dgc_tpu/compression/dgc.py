@@ -254,6 +254,14 @@ class DGCCompressor(Compressor):
             return True
         return False
 
+    def elastic_reshard_opts(self) -> Dict[str, bool]:
+        """Kwargs for ``resilience.elastic.reshard_state`` that depend on
+        this compressor's memory semantics: whether the deferred transmit
+        record also masks the momentum accumulator decides which buffers
+        the pending ``sent_bits`` fold zeroes before workers merge."""
+        return {"momentum_masking":
+                bool(getattr(self.memory, "momentum_masking", True))}
+
     def make_flat_exchange(self, layout):
         """Flat-path capability (see ``dgc_tpu.compression.flat``): fused
         whole-model pipeline over a :class:`ParamLayout`. Discovered by the

@@ -25,7 +25,17 @@ import jax.numpy as jnp
 
 from dgc_tpu.ops.sparsify import transmitted_mask
 
-__all__ = ["Memory", "DGCSGDMemory"]
+__all__ = ["Memory", "DGCSGDMemory", "ELASTIC_ADDITIVE_PREFIXES"]
+
+#: [world]-axis reshard semantics for elastic restarts
+#: (``dgc_tpu.resilience.elastic``): any error-feedback state key whose
+#: name starts with one of these prefixes is ADDITIVE — the residual is
+#: exactly the compensated gradient mass a worker has not yet
+#: transmitted (Lin et al., ICLR 2018 §3), so merging k workers by
+#: summation conserves every coordinate's owed gradient. Keys outside
+#: this registry (other than the flat engine's ``sent_bits`` transmit
+#: record) make the resharder refuse rather than guess a reduction.
+ELASTIC_ADDITIVE_PREFIXES = ("momentums", "velocities")
 
 
 class Memory:

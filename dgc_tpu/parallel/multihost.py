@@ -145,13 +145,30 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
-def local_batch_slice(global_batch: int):
+def local_batch_slice(global_batch: int, num_processes: int = None,
+                      process_id: int = None):
     """The slice of a [global_batch, ...] host array this process should
     feed. Data loading is per-host: each process materializes only its
-    shard (the DistributedSampler role, reference train.py:99-100)."""
-    per = global_batch // jax.process_count()
-    start = jax.process_index() * per
-    return slice(start, start + per)
+    shard (the DistributedSampler role, reference train.py:99-100).
+
+    Fails fast on a non-divisible batch: flooring it here would make
+    every host silently feed fewer samples — the effective global batch
+    (and with it the LR scaling story) shrinks with no error anywhere
+    downstream, since each host only ever sees its own shard.
+    ``num_processes``/``process_id`` default to the live ``jax``
+    values; tests pass them explicitly."""
+    n = jax.process_count() if num_processes is None else int(num_processes)
+    i = jax.process_index() if process_id is None else int(process_id)
+    per, rem = divmod(int(global_batch), n)
+    if rem:
+        raise ValueError(
+            f"global batch {global_batch} does not split evenly over {n} "
+            f"processes (remainder {rem}): each host would silently feed "
+            f"{per} samples and the effective global batch would shrink "
+            f"to {per * n}. Use a global batch divisible by {n} (e.g. "
+            f"{per * n} or {(per + 1) * n}) — adjust train.batch_size or "
+            "train.num_batches_per_step")
+    return slice(i * per, (i + 1) * per)
 
 
 def host_local_to_global(arr, mesh, axis=None):

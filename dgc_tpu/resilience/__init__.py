@@ -17,6 +17,10 @@ pairs every guard with a deterministic injector that triggers it in tests:
   distributed shutdown; watchdog thread for stalled steps.
 * :mod:`faults` — env-driven deterministic fault injection
   (``DGC_FAULTS=nan@2,bitflip:elem=0:bit=18,...``).
+* :mod:`elastic` — restart across world-size changes: merge/split the
+  per-worker ``[world]`` state with exact gradient-mass conservation
+  (``CheckpointManager.restore(elastic=True)``; ``scripts/supervise.py``
+  drives the relaunch loop).
 """
 
 from dgc_tpu.resilience.guard import GuardConfig, init_state

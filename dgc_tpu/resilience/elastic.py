@@ -1,0 +1,333 @@
+"""Elastic-topology restart: reshard per-worker DGC state across
+world-size changes (HOST-side code, docs/RESILIENCE.md §"Elastic
+restart").
+
+DGC's correctness hinges on per-worker local state — the momentum-
+corrected accumulators and the error-feedback residual (Lin et al., ICLR
+2018, PAPER.md §"momentum correction / local gradient accumulation") —
+which checkpoints store under a leading ``[world]`` axis. A preempted pod
+slice frequently comes back with a *different* process count; without
+resharding, the topology record makes restore fail fast and the run is
+stranded. This module converts that state between world sizes with
+**exact gradient-mass conservation**:
+
+* **merge** (shrink, ``from % to == 0``): error feedback is *additive* —
+  a worker's residual is exactly the compensated gradient mass it has not
+  yet transmitted, so the union of k workers owes the sum of their
+  residuals. Each group of k parents is summed into one child. The flat
+  engine defers its transmit mask (``sent_bits`` is applied on the NEXT
+  compensate read), so each parent's pending mask is **folded first** —
+  summing raw buffers would resurrect already-transmitted mass.
+* **split** (grow, ``to % from == 0``): residual state cannot be
+  invented, and duplicating it would double-count gradient mass. One
+  child per parent inherits the parent's buffers **bitwise** (pending
+  ``sent_bits`` included); its siblings start with zero residual — total
+  mass unchanged.
+* **collapse** (non-divisible): everything merges into child 0, siblings
+  start empty. Mass-exact, but worker/data alignment is lost; logged.
+* **BN stats** are per-worker *running statistics*, not additive mass:
+  merge is a mean-reduce; split copies the parent's stats to every child
+  (zeros would be invalid statistics).
+
+What is and is not bitwise: a split child inherits bitwise; a merge is
+exact up to float addition order (sums accumulate in float32 and round
+once back to the state dtype). The optimizer state and params are
+replicated and pass through untouched; the Adasum per-worker opt-state
+scheme has no principled merge (optimizer state is not additive) and is
+refused.
+
+Everything here is host-side numpy over host-materialized state — it
+runs once at restore time and never enters the jitted step (the
+``elastic-off-compiles-away`` contract in ``dgc_tpu.analysis.suite``
+pins that ``elastic=False`` programs never mention this module).
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["reshard_state", "with_world", "resolve_batch_geometry",
+           "fold_pending_mask", "keep_from_bits_np"]
+
+
+# --------------------------------------------------------------------- #
+# transmit-record fold (NumPy mirror of ops.kernels.keep_from_bits)
+# --------------------------------------------------------------------- #
+
+def keep_from_bits_np(bits: np.ndarray, total: int) -> np.ndarray:
+    """Packed int32 word record ``[W]`` -> bool keep mask ``[total]``
+    (True = NOT transmitted). Same layout as ``kernels.pack_sent_bits``:
+    flat position ``p`` lives in word ``(p // 4096) * 128 + (p % 128)``,
+    bit ``(p // 128) % 32``."""
+    words = np.asarray(bits).astype(np.uint32).reshape(-1, 1, 128)
+    m = np.arange(32, dtype=np.uint32)[None, :, None]
+    keep = ((words >> m) & np.uint32(1)) == 0
+    return keep.reshape(-1)[:int(total)]
+
+
+def fold_pending_mask(mem: Dict[str, Any],
+                      momentum_masking: bool = True) -> Dict[str, Any]:
+    """One worker's flat-engine memory dict (no ``[world]`` axis) with
+    its deferred ``sent_bits`` mask applied and cleared.
+
+    The engine zeroes transmitted velocity coordinates on the *next*
+    compensate read (momentum too, iff ``momentum_masking``); merging
+    workers must see post-mask buffers or transmitted mass re-enters the
+    sum. Non-flat memory (no ``sent_bits``) passes through unchanged —
+    the per-tensor format masks eagerly."""
+    if not (isinstance(mem, dict) and "sent_bits" in mem):
+        return mem
+    out = dict(mem)
+    bits = np.asarray(out["sent_bits"])
+    vc = out.get("velocities_c")
+    total = int(np.shape(vc)[-1]) if vc is not None else 0
+    if total and bits.size:
+        keep = keep_from_bits_np(bits, total)
+        # np.where with a 0-d zero of the SAME dtype keeps bf16 et al.
+        # bitwise for the kept coordinates (no float64 round trip)
+        vc = np.asarray(vc)
+        out["velocities_c"] = np.where(keep, vc, np.zeros((), vc.dtype))
+        if momentum_masking and "momentums_c" in out:
+            mc = np.asarray(out["momentums_c"])
+            out["momentums_c"] = np.where(keep, mc,
+                                          np.zeros((), mc.dtype))
+    out["sent_bits"] = np.zeros_like(bits)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# per-worker slicing / merging primitives
+# --------------------------------------------------------------------- #
+
+def _leaf_path(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                    for k in path)
+
+
+def _check_memory_keys(memory: Any) -> None:
+    """Refuse to reshard compressor state whose merge semantics are
+    undeclared: every leaf must be either additive error-feedback mass
+    (``compression.memory.ELASTIC_ADDITIVE_PREFIXES``) or the flat
+    engine's transmit record (cleared by the fold)."""
+    from dgc_tpu.compression.memory import ELASTIC_ADDITIVE_PREFIXES
+    for path, _ in jax.tree_util.tree_flatten_with_path(memory)[0]:
+        name = _leaf_path(path)
+        last = name.rsplit("/", 1)[-1]
+        if last == "sent_bits":
+            continue
+        if any(part.startswith(ELASTIC_ADDITIVE_PREFIXES)
+               for part in name.split("/")):
+            continue
+        raise ValueError(
+            f"cannot elastically reshard compressor-memory key {name!r}: "
+            "its [world]-axis merge semantics are undeclared — extend "
+            "compression.memory.ELASTIC_ADDITIVE_PREFIXES (if it is "
+            "additive error-feedback mass) or teach resilience/elastic.py "
+            "its reduction before resuming across topologies")
+
+
+def _host(x) -> np.ndarray:
+    return np.asarray(jax.device_get(x))
+
+
+def _worker(tree: Any, w: int) -> Any:
+    return jax.tree.map(lambda x: _host(x)[w], tree)
+
+
+def _zeros_like_tree(tree: Any) -> Any:
+    return jax.tree.map(lambda x: np.zeros(np.shape(x), x.dtype), tree)
+
+
+def _sum_workers(workers: List[Any]) -> Any:
+    """Leafwise sum over worker pytrees: float leaves accumulate in
+    float32 (one rounding back to the state dtype — "bitwise up to fp
+    addition"); integer leaves are transmit records already zeroed by
+    the fold, so the first one passes through."""
+    def merge(*xs):
+        x0 = np.asarray(xs[0])
+        if not np.issubdtype(x0.dtype, np.floating):
+            return x0.copy()
+        acc = np.zeros(x0.shape, np.float32)
+        for x in xs:
+            acc = acc + np.asarray(x, np.float32)
+        return acc.astype(x0.dtype)
+    return jax.tree.map(merge, *workers)
+
+
+def _mean_workers(workers: List[Any]) -> Any:
+    """Leafwise mean (BN running stats): a merged worker's running
+    statistics are the cross-replica average, the same reduction eval
+    uses to reconcile per-worker BN stats."""
+    def mean(*xs):
+        x0 = np.asarray(xs[0])
+        if not np.issubdtype(x0.dtype, np.floating):
+            return x0.copy()
+        acc = np.zeros(x0.shape, np.float32)
+        for x in xs:
+            acc = acc + np.asarray(x, np.float32)
+        return (acc / np.float32(len(xs))).astype(x0.dtype)
+    return jax.tree.map(mean, *workers)
+
+
+def _stack_workers(workers: List[Any]) -> Any:
+    return jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs], axis=0),
+        *workers)
+
+
+def _check_leading_axis(tree: Any, world: int, what: str) -> None:
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        shape = np.shape(leaf)
+        if not shape or shape[0] != world:
+            raise ValueError(
+                f"{what} leaf {_leaf_path(path)!r} has shape {shape}, "
+                f"expected a leading [world={world}] axis — the state "
+                "does not match the checkpoint's recorded topology")
+
+
+# --------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------- #
+
+def with_world(state: Any, world: int, per_worker_opt: bool = False) -> Any:
+    """Restore template for a checkpoint written under ``world`` workers:
+    every per-worker leaf (memory, batch_stats, and — under the Adasum
+    scheme — opt_state) is replaced by host-numpy zeros with the leading
+    axis retiled to ``world``; replicated fields pass through."""
+    from dgc_tpu.training.state import map_per_worker
+
+    def retile(tree):
+        return jax.tree.map(
+            lambda x: np.zeros((int(world),) + tuple(np.shape(x)[1:]),
+                               x.dtype), tree)
+    return map_per_worker(state, retile, per_worker_opt=per_worker_opt)
+
+
+def reshard_state(host_state: Any, from_topo: Dict[str, int],
+                  to_topo: Dict[str, int], *,
+                  momentum_masking: bool = True,
+                  per_worker_opt: bool = False,
+                  log=print) -> Any:
+    """Convert host-materialized per-worker state between world sizes.
+
+    ``host_state`` — a TrainState whose memory/batch_stats leaves carry a
+    leading ``[from_topo['world']]`` axis (host numpy or addressable
+    arrays). ``momentum_masking`` — whether the pending transmit record
+    also masks the momentum accumulator (``DGCCompressor.
+    elastic_reshard_opts()`` supplies it from the live compressor).
+    Returns a new state with the leading axis resized to
+    ``to_topo['world']``; replicated fields (step, params, opt_state,
+    guards) are untouched.
+    """
+    fw, tw = int(from_topo["world"]), int(to_topo["world"])
+    if fw <= 0 or tw <= 0:
+        raise ValueError(f"world sizes must be positive, got {fw}->{tw}")
+    fl = int(from_topo.get("num_local_workers", 1) or 1)
+    tl = int(to_topo.get("num_local_workers", 1) or 1)
+    if fl != tl:
+        raise RuntimeError(
+            f"elastic restart cannot reshard across tier configurations "
+            f"(num_local_workers {fl} -> {tl}): the two-tier error-"
+            "feedback memory has per-NODE semantics — restart with the "
+            "same num_local_workers or a fresh experiment directory")
+    if per_worker_opt:
+        raise NotImplementedError(
+            "elastic restart is not supported with per-worker optimizer "
+            "state (the Adasum delta-optimizer scheme): optimizer "
+            "moments are not additive across workers, so no mass-"
+            "conserving merge exists — resume at the original world "
+            "size or restart the optimizer from scratch")
+    if fw == tw:
+        return host_state
+
+    _check_memory_keys(host_state.memory)
+    _check_leading_axis(host_state.memory, fw, "memory")
+    _check_leading_axis(host_state.batch_stats, fw, "batch_stats")
+
+    mem_w = [_worker(host_state.memory, w) for w in range(fw)]
+    bn_w = [_worker(host_state.batch_stats, w) for w in range(fw)]
+
+    if fw % tw == 0:
+        k = fw // tw
+        log(f"[elastic] merging {fw} workers -> {tw} "
+            f"({k}:1, error feedback summed, BN stats mean-reduced)")
+        folded = [fold_pending_mask(m, momentum_masking) for m in mem_w]
+        new_mem = [_sum_workers(folded[c * k:(c + 1) * k])
+                   for c in range(tw)]
+        new_bn = [_mean_workers(bn_w[c * k:(c + 1) * k])
+                  for c in range(tw)]
+    elif tw % fw == 0:
+        k = tw // fw
+        log(f"[elastic] splitting {fw} workers -> {tw} "
+            f"(1:{k}, one child inherits the parent residual bitwise, "
+            "siblings start empty; BN stats copied)")
+        # child c of parent c // k: the first child inherits bitwise
+        # (pending sent_bits included — the deferred mask stays valid
+        # because the buffers it masks moved with it)
+        new_mem = [mem_w[c // k] if c % k == 0
+                   else _zeros_like_tree(mem_w[c // k])
+                   for c in range(tw)]
+        new_bn = [bn_w[c // k] for c in range(tw)]
+    else:
+        log(f"[elastic] world {fw} -> {tw} is not divisible either way: "
+            "collapsing all residual mass into worker 0 (exact total "
+            "mass, but per-worker/data alignment is lost)")
+        folded = [fold_pending_mask(m, momentum_masking) for m in mem_w]
+        total = _sum_workers(folded)
+        new_mem = [total if c == 0 else _zeros_like_tree(total)
+                   for c in range(tw)]
+        gmean = _mean_workers(bn_w)
+        new_bn = [gmean for _ in range(tw)]
+
+    return host_state.replace(memory=_stack_workers(new_mem),
+                              batch_stats=_stack_workers(new_bn))
+
+
+def resolve_batch_geometry(from_world: int, to_world: int, nbps: int,
+                           preserve: bool = True
+                           ) -> Tuple[int, Optional[str]]:
+    """Degraded-mode batch geometry: the new ``num_batches_per_step``.
+
+    The global batch is ``world * nbps * batch_size`` and the scaled LR
+    is ``base_lr * nbps * world`` — preserving the ``nbps * world``
+    product preserves the global batch, the LR, the steps-per-epoch
+    count, AND the meaning of a mid-epoch ``preempt_batch`` cursor. A
+    shrunk cohort therefore *raises* per-host microbatch accumulation
+    instead of silently changing the effective batch size.
+
+    Returns ``(new_nbps, note)``; raises with an actionable message when
+    the product cannot be preserved with an integer nbps."""
+    fw, tw, nbps = int(from_world), int(to_world), int(nbps)
+    if nbps < 1:
+        raise ValueError(f"num_batches_per_step must be >= 1, got {nbps}")
+    if fw == tw:
+        return nbps, None
+    if not preserve:
+        return nbps, (
+            f"preserve_global_batch=False: world {fw} -> {tw} changes the "
+            f"effective global batch by {tw / fw:g}x (LR rescales with it)")
+    if fw % tw == 0:
+        k = fw // tw
+        return nbps * k, (
+            f"cohort shrank {fw} -> {tw}: raising num_batches_per_step "
+            f"{nbps} -> {nbps * k} to preserve the global batch and LR")
+    if tw % fw == 0:
+        k = tw // fw
+        if nbps % k == 0:
+            return nbps // k, (
+                f"cohort grew {fw} -> {tw}: lowering num_batches_per_step "
+                f"{nbps} -> {nbps // k} to preserve the global batch and LR")
+        raise RuntimeError(
+            f"cannot preserve the global batch growing {fw} -> {tw} "
+            f"workers: num_batches_per_step {nbps} is not divisible by "
+            f"{k}. Relaunch with --train.num_batches_per_step a multiple "
+            f"of {k}, or set train.elastic.preserve_global_batch False "
+            f"to accept a {k}x larger global batch")
+    raise RuntimeError(
+        f"elastic restart {fw} -> {tw} workers cannot preserve the "
+        f"global batch: neither world size divides the other and "
+        f"num_batches_per_step is integral. Relaunch with a world size "
+        f"that divides (or is a multiple of) {fw}, or set "
+        "train.elastic.preserve_global_batch False to accept the "
+        "changed batch geometry")

@@ -30,7 +30,7 @@ import time
 from typing import Callable, Optional
 
 __all__ = ["PreemptionHandler", "Watchdog", "agree_preempt",
-           "clean_shutdown"]
+           "clean_shutdown", "emergency_save"]
 
 
 class PreemptionHandler:
@@ -142,6 +142,26 @@ def agree_preempt(local_flag: bool) -> bool:
     flags = multihost_utils.process_allgather(
         np.asarray([1.0 if local_flag else 0.0], np.float32))
     return bool(np.sum(flags) > 0)
+
+
+def emergency_save(ckpt, epoch: int, state, meters: dict,
+                   topology: Optional[dict] = None) -> str:
+    """The one blessed emergency-checkpoint call: a preemption save with
+    the ``_topology`` record ALWAYS stamped.
+
+    An elastic restart (``resilience.elastic``) can only reshard a
+    preempted run onto a different world size if the emergency
+    checkpoint says which ``[world]`` axis its per-worker error-feedback
+    state was written under — an unstamped save strands the run exactly
+    in the scenario elastic restarts exist for (the pod slice comes back
+    with a different process count). ``topology=None`` derives the
+    record from the live ``jax`` runtime."""
+    import jax
+    if topology is None:
+        topology = {"process_count": jax.process_count(),
+                    "world": len(jax.devices()),
+                    "num_local_workers": 1}
+    return ckpt.save(epoch, state, meters, topology=dict(topology))
 
 
 def clean_shutdown() -> None:

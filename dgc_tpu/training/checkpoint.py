@@ -159,6 +159,30 @@ class CheckpointManager:
         return np.ascontiguousarray(
             words.reshape(words.shape[:-2] + (-1,)).astype(np.int32))
 
+    def saved_topology(self) -> Optional[Dict[str, int]]:
+        """The ``_topology`` record of the newest restorable checkpoint
+        (latest pointer first, then kept epochs, mirroring ``restore``'s
+        walk), or None when there is nothing to resume or the checkpoint
+        predates topology records. ``train.py`` reads this BEFORE
+        building the step so an elastic restart can resolve its batch
+        geometry (``resilience.elastic.resolve_batch_geometry``) against
+        the world size the state was actually written under."""
+        latest = self.latest_epoch()
+        candidates = self._kept_epochs()
+        if latest is not None:
+            candidates = [latest] + [e for e in candidates if e != latest]
+        for ep in candidates:
+            meters_path = os.path.join(self._epoch_dir(ep), "meters.json")
+            if not os.path.exists(meters_path):
+                continue
+            try:
+                with open(meters_path) as f:
+                    topo = json.load(f).get("_topology")
+            except (ValueError, OSError):
+                continue        # torn meters: restore() will skip it too
+            return dict(topo) if topo else None
+        return None
+
     def latest_epoch(self) -> Optional[int]:
         if not os.path.exists(self._meta_path()):
             return None
@@ -182,7 +206,9 @@ class CheckpointManager:
 
     def restore(self, template: Any, epoch: Optional[int] = None,
                 best: bool = False,
-                topology: Optional[Dict[str, int]] = None
+                topology: Optional[Dict[str, int]] = None,
+                elastic: bool = False,
+                elastic_opts: Optional[Dict[str, Any]] = None
                 ) -> Optional[Tuple[Any, int, Dict[str, float]]]:
         """Restore (state, epoch, meters); None when nothing to resume.
 
@@ -192,6 +218,19 @@ class CheckpointManager:
         tier config), a mismatch raises an explicit error BEFORE the
         restore instead of failing deep inside orbax/XLA with an opaque
         sharding message.
+
+        ``elastic=True`` (opt-in; the default stays fail-fast) turns a
+        *world-size* mismatch into a host-side reshard instead: the
+        state is restored to host numpy under the checkpoint's recorded
+        world, run through ``resilience.elastic.reshard_state`` (error
+        feedback merged/split with exact mass conservation), and handed
+        back as a HOST pytree the caller must re-shard; the returned
+        meters carry an ``_elastic`` record describing the conversion.
+        ``elastic_opts`` forwards compressor-memory semantics
+        (``DGCCompressor.elastic_reshard_opts()``) plus
+        ``per_worker_opt`` for the Adasum scheme (refused). Checkpoints
+        that predate ``_topology`` records restore as "written under the
+        current topology, non-elastic" with a logged warning.
 
         When no explicit ``epoch`` is given and the newest checkpoint is
         corrupt (crash mid-write before atomic saves, truncated array
@@ -206,7 +245,8 @@ class CheckpointManager:
                 return None
             try:
                 return self._restore_one(path, -1, template, topology,
-                                         best=True)
+                                         best=True, elastic=elastic,
+                                         elastic_opts=elastic_opts)
             except RuntimeError:
                 raise
             except Exception as e:
@@ -226,7 +266,8 @@ class CheckpointManager:
                 continue
             try:
                 return self._restore_one(path, ep, template, topology,
-                                         best=False)
+                                         best=False, elastic=elastic,
+                                         elastic_opts=elastic_opts)
             except RuntimeError:
                 raise                     # topology mismatch: config error
             except Exception as e:
@@ -244,7 +285,9 @@ class CheckpointManager:
         return s[0] if s else type(e).__name__
 
     def _restore_one(self, path: str, epoch: int, template: Any,
-                     topology: Optional[Dict[str, int]], best: bool
+                     topology: Optional[Dict[str, int]], best: bool,
+                     elastic: bool = False,
+                     elastic_opts: Optional[Dict[str, Any]] = None
                      ) -> Tuple[Any, int, Dict[str, float]]:
         """Restore one checkpoint directory or raise (the public
         ``restore`` turns failures into kept-epoch fallback)."""
@@ -253,37 +296,84 @@ class CheckpointManager:
         if os.path.exists(meters_path):
             with open(meters_path) as f:
                 saved_topology = json.load(f).get("_topology")
-        if topology is not None and saved_topology is not None \
-                and dict(saved_topology) != dict(topology):
+        if topology is not None and saved_topology is None:
+            # pre-_topology checkpoint (PR-3-era and earlier): there is
+            # nothing to compare or reshard against — treat it as written
+            # under the current topology and restore non-elastically
+            print(f"[checkpoint] {path} has no _topology record "
+                  "(pre-elastic checkpoint): assuming it was written "
+                  f"under the current topology {dict(topology)}; elastic "
+                  "resharding is unavailable for it")
+        mismatch = (topology is not None and saved_topology is not None
+                    and dict(saved_topology) != dict(topology))
+        elastic_info = None
+        if mismatch and elastic:
+            # opt-in elastic path: restore to host numpy under the world
+            # the checkpoint was written at, then merge/split the
+            # per-worker [world] axis (resilience/elastic.py) — the
+            # caller re-shards the returned HOST state onto its mesh
+            from dgc_tpu.resilience import elastic as _elastic
+            opts = dict(elastic_opts or {})
+            per_worker_opt = bool(opts.pop("per_worker_opt", False))
+            old = _elastic.with_world(template,
+                                      int(saved_topology["world"]),
+                                      per_worker_opt=per_worker_opt)
+            state = self._restore_guarded(path, old, force_host=True)
+            state = _elastic.reshard_state(
+                state, saved_topology, topology,
+                per_worker_opt=per_worker_opt, **opts)
+            elastic_info = {
+                "from_world": int(saved_topology["world"]),
+                "to_world": int(topology["world"]),
+                "from_process_count":
+                    int(saved_topology.get("process_count", 1)),
+                "to_process_count": int(topology.get("process_count", 1)),
+            }
+        elif mismatch:
             raise RuntimeError(
                 f"checkpoint at {path} was written under topology "
                 f"{saved_topology} but this run has {dict(topology)} — "
-                "resume with the same process/mesh/tier configuration, or "
-                "start a fresh experiment directory")
-        try:
-            state = self._restore_state(path, template)
-        except Exception:
-            if getattr(template, "guards", None) is None:
-                raise
-            # pre-resilience checkpoint (no guard-counter subtree): retry
-            # without it — the caller re-seeds fresh guard state rather
-            # than discarding an otherwise-good checkpoint
-            state = self._restore_state(path, template.replace(guards=None))
-            print(f"[checkpoint] {path} predates the resilience guard "
-                  "counters — they start fresh")
+                "resume with the same process/mesh/tier configuration, "
+                "pass elastic=True (--elastic) to reshard the per-worker "
+                "state across the world-size change, or start a fresh "
+                "experiment directory")
+        else:
+            state = self._restore_guarded(path, template)
         meters: Dict[str, float] = {}
         if os.path.exists(meters_path):
             with open(meters_path) as f:
                 meters = json.load(f)
         meters.pop("_topology", None)
+        if elastic_info is not None:
+            meters["_elastic"] = elastic_info
         if best:
             epoch = int(meters.pop("epoch", epoch))
         else:
             meters.pop("epoch", None)
         return state, epoch, meters
 
-    def _restore_state(self, path: str, template: Any) -> Any:
-        if jax.process_count() > 1:
+    def _restore_guarded(self, path: str, template: Any,
+                         force_host: bool = False) -> Any:
+        """``_restore_state`` with the pre-resilience fallback: a
+        checkpoint without the guard-counter subtree retries without it
+        (the caller re-seeds fresh guard state rather than discarding an
+        otherwise-good checkpoint)."""
+        try:
+            return self._restore_state(path, template,
+                                       force_host=force_host)
+        except Exception:
+            if getattr(template, "guards", None) is None:
+                raise
+            state = self._restore_state(path,
+                                        template.replace(guards=None),
+                                        force_host=force_host)
+            print(f"[checkpoint] {path} predates the resilience guard "
+                  "counters — they start fresh")
+            return state
+
+    def _restore_state(self, path: str, template: Any,
+                       force_host: bool = False) -> Any:
+        if jax.process_count() > 1 and not force_host:
             # restore straight into the live sharded layout: global arrays
             # cannot be host-materialized per process, and the sharding on
             # the abstract template tells orbax how to place each shard

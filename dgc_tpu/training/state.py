@@ -22,7 +22,8 @@ import numpy as np
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["TrainState", "shard_state", "state_specs", "with_leading_axis"]
+__all__ = ["TrainState", "shard_state", "state_specs", "with_leading_axis",
+           "map_per_worker"]
 
 
 class TrainState(struct.PyTreeNode):
@@ -44,6 +45,22 @@ def with_leading_axis(tree: Any, world_size: int) -> Any:
     return jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (world_size,) + x.shape)
         if hasattr(x, "shape") else x, tree)
+
+
+def map_per_worker(state: TrainState, fn,
+                   per_worker_opt: bool = False) -> TrainState:
+    """Apply ``fn`` to each PER-WORKER field subtree — exactly the fields
+    :func:`state_specs` shards on the data axis (memory, batch_stats,
+    and opt_state under the Adasum per-worker scheme) — leaving the
+    replicated fields untouched. The single place that knows which state
+    carries a leading ``[world]`` axis; elastic resharding
+    (``dgc_tpu.resilience.elastic``) retiles through it so it cannot
+    drift from the sharding rules below."""
+    out = state.replace(memory=fn(state.memory),
+                        batch_stats=fn(state.batch_stats))
+    if per_worker_opt:
+        out = out.replace(opt_state=fn(state.opt_state))
+    return out
 
 
 def state_specs(state: TrainState, axis="data",

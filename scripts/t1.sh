@@ -24,6 +24,12 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "RESILIENCE_SMOKE=ok" || { echo "RESILIENCE_SMOKE=FAIL"; rc=1; }
+# elastic smoke (docs/RESILIENCE.md §"Elastic restart"): mass-conserving
+# reshard units + one supervised kill -> emergency save -> exit 75 ->
+# relaunch -> resume-and-complete loop through scripts/supervise.py
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "ELASTIC_SMOKE=ok" || { echo "ELASTIC_SMOKE=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite — nonzero on any un-allowlisted finding
 # or broken step invariant (one sparse exchange, telemetry compiles away,

@@ -170,7 +170,8 @@ def main():
             faults.maybe_kill(i + 1)     # SIGTERM self at the armed step
         assert preempt_at == KILL_STEP - 1, \
             f"expected preemption after step {KILL_STEP}, got {preempt_at}"
-        ckpt.save(0, state, {"preempt_batch": preempt_at})
+        preempt.emergency_save(ckpt, 0, state,
+                               {"preempt_batch": preempt_at})
         out.update(losses=losses, preempt_at=preempt_at,
                    mem_saved=fingerprint(state.memory),
                    signum=handler.signum)

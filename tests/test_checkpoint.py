@@ -95,6 +95,26 @@ def test_topology_mismatch_raises_clearly(tmp_path):
         mgr.restore(_state(0.0), topology=other)
 
 
+def test_pre_topology_checkpoint_restores_with_warning(tmp_path, capsys):
+    """PR-3-era checkpoints have no ``_topology`` key in meters.json:
+    they must restore as "current topology, non-elastic" with a logged
+    warning — with and without ``elastic=True`` (which has nothing to
+    reshard against and must not invent a world size)."""
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, _state(3.0), {"m": 1.0})          # note: no topology=
+    assert mgr.saved_topology() is None
+    topo = {"process_count": 1, "world": 8, "num_local_workers": 1}
+    for elastic in (False, True):
+        out = mgr.restore(_state(0.0), topology=topo, elastic=elastic)
+        assert out is not None
+        state, _, meters = out
+        np.testing.assert_allclose(state.params["w"], 3.0)
+        assert "_elastic" not in meters and "_topology" not in meters
+        cap = capsys.readouterr().out
+        assert "no _topology record" in cap
+        assert "current topology" in cap
+
+
 def test_legacy_transmit_record_checkpoints_migrate(tmp_path):
     """v0.2 checkpoints carry the deferred-mask state as a full-[T] keep
     MASK ('keep_c', 1.0 = keep); v0.3 as a transmit COUNT ('sent_c',

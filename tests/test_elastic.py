@@ -1,0 +1,331 @@
+"""Elastic-topology restart (docs/RESILIENCE.md §"Elastic restart"):
+unit tests for ``dgc_tpu.resilience.elastic`` (mass-conserving reshard,
+pending-mask fold, batch-geometry resolution), the checkpoint-layer
+``elastic=True`` wiring, the fail-fast ``local_batch_slice``, and a
+supervised relaunch smoke through ``scripts/supervise.py`` (kill@3 ->
+emergency save -> exit 75 -> relaunch -> resume mid-run -> complete).
+
+Everything here is marked ``fast``: scripts/t1.sh runs this module as
+ELASTIC_SMOKE."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgc_tpu.parallel.multihost import local_batch_slice
+from dgc_tpu.resilience import elastic
+from dgc_tpu.training import TrainState
+from dgc_tpu.training.checkpoint import CheckpointManager
+
+pytestmark = pytest.mark.fast
+
+pack_bits = CheckpointManager._pack_transmitted_np
+
+
+# --------------------------------------------------------------------- #
+# transmit-record fold
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("total", [8, 4096, 4096 + 5, 3 * 4096])
+def test_keep_from_bits_inverts_pack(total):
+    rng = np.random.RandomState(total)
+    transmitted = rng.rand(total) < 0.3
+    bits = pack_bits(transmitted)
+    keep = elastic.keep_from_bits_np(bits, total)
+    np.testing.assert_array_equal(keep, ~transmitted)
+
+
+@pytest.mark.parametrize("momentum_masking", [True, False])
+def test_fold_pending_mask(momentum_masking):
+    T = 8
+    transmitted = np.zeros(T, bool)
+    transmitted[[1, 4]] = True
+    mem = {"momentums_c": np.arange(1., T + 1, dtype=np.float32),
+           "velocities_c": np.arange(10., T + 10, dtype=np.float32),
+           "momentums_d": np.full(3, 7., np.float32),
+           "velocities_d": np.zeros(3, np.float32),
+           "sent_bits": pack_bits(transmitted)}
+    out = elastic.fold_pending_mask(mem, momentum_masking)
+    # velocities always fold; momentums only under momentum_masking
+    want_v = np.where(transmitted, 0., mem["velocities_c"])
+    np.testing.assert_array_equal(out["velocities_c"], want_v)
+    want_m = np.where(transmitted, 0., mem["momentums_c"]) \
+        if momentum_masking else mem["momentums_c"]
+    np.testing.assert_array_equal(out["momentums_c"], want_m)
+    # the record is consumed, dense tail untouched
+    assert out["sent_bits"].sum() == 0
+    np.testing.assert_array_equal(out["momentums_d"], mem["momentums_d"])
+    # per-tensor memory (no sent_bits) passes through unchanged
+    pt = {"momentums": {"a": np.ones(3)}, "velocities": {"a": np.ones(3)}}
+    assert elastic.fold_pending_mask(pt) is pt
+
+
+# --------------------------------------------------------------------- #
+# reshard_state on host numpy state
+# --------------------------------------------------------------------- #
+
+def _topo(world, nlocal=1):
+    return {"process_count": 1, "world": world,
+            "num_local_workers": nlocal}
+
+
+def _worker_state(world, n=6, seed=0):
+    """Per-tensor-format state with a leading [world] axis everywhere a
+    worker owns state; params/opt replicated."""
+    rng = np.random.RandomState(seed)
+    return TrainState(
+        step=jnp.asarray(5, jnp.int32),
+        params={"w": jnp.asarray(rng.randn(4), jnp.float32)},
+        opt_state=(jnp.zeros(()),),
+        memory={"momentums": {"a": rng.randn(world, n).astype(np.float32)},
+                "velocities": {"a": rng.randn(world, n).astype(np.float32)}},
+        batch_stats={"bn": {"mean": rng.randn(world, 3).astype(np.float32),
+                            "var": rng.rand(world, 3).astype(np.float32)}},
+    )
+
+
+def test_merge_sums_residuals_means_bn():
+    s = _worker_state(4)
+    out = elastic.reshard_state(s, _topo(4), _topo(2), log=lambda *_: None)
+    for key in ("momentums", "velocities"):
+        old = np.asarray(s.memory[key]["a"], np.float64)
+        new = np.asarray(out.memory[key]["a"], np.float64)
+        assert new.shape == (2, 6)
+        np.testing.assert_allclose(new[0], old[0] + old[1], rtol=1e-6)
+        np.testing.assert_allclose(new[1], old[2] + old[3], rtol=1e-6)
+        # total gradient mass conserved
+        np.testing.assert_allclose(new.sum(), old.sum(), rtol=1e-5)
+    for key in ("mean", "var"):
+        old = np.asarray(s.batch_stats["bn"][key], np.float64)
+        new = np.asarray(out.batch_stats["bn"][key], np.float64)
+        np.testing.assert_allclose(new[0], old[:2].mean(0), rtol=1e-5)
+        np.testing.assert_allclose(new[1], old[2:].mean(0), rtol=1e-5)
+    # replicated fields pass through untouched
+    np.testing.assert_array_equal(out.params["w"], s.params["w"])
+    assert int(out.step) == int(s.step)
+
+
+def test_merge_folds_flat_pending_mask():
+    """2 -> 1 on flat-engine memory: worker 1 has a pending transmit
+    record; its transmitted coordinates must NOT re-enter the sum."""
+    T = 8
+    transmitted = np.zeros(T, bool)
+    transmitted[2] = True
+    mem = {"momentums_c": np.stack([np.full(T, 1., np.float32),
+                                    np.full(T, 10., np.float32)]),
+           "velocities_c": np.stack([np.full(T, 2., np.float32),
+                                     np.full(T, 20., np.float32)]),
+           "sent_bits": np.stack([pack_bits(np.zeros(T, bool)),
+                                  pack_bits(transmitted)])}
+    s = _worker_state(2).replace(memory=mem)
+    out = elastic.reshard_state(s, _topo(2), _topo(1),
+                                momentum_masking=True, log=lambda *_: None)
+    want = np.full(T, 1. + 10., np.float32)
+    want[2] = 1.  # worker 1's coordinate 2 was already transmitted
+    np.testing.assert_array_equal(out.memory["momentums_c"][0], want)
+    want_v = np.full(T, 2. + 20., np.float32)
+    want_v[2] = 2.
+    np.testing.assert_array_equal(out.memory["velocities_c"][0], want_v)
+    assert np.asarray(out.memory["sent_bits"]).sum() == 0
+    # momentum_masking=False folds velocities only
+    out2 = elastic.reshard_state(s, _topo(2), _topo(1),
+                                 momentum_masking=False,
+                                 log=lambda *_: None)
+    np.testing.assert_array_equal(out2.memory["momentums_c"][0],
+                                  np.full(T, 11., np.float32))
+
+
+def test_split_one_child_inherits_bitwise():
+    s = _worker_state(2)
+    out = elastic.reshard_state(s, _topo(2), _topo(4), log=lambda *_: None)
+    old = np.asarray(s.memory["momentums"]["a"])
+    new = np.asarray(out.memory["momentums"]["a"])
+    assert new.shape == (4, 6)
+    # child c of parent c//2; c%2==0 inherits bitwise, siblings empty
+    np.testing.assert_array_equal(new[0], old[0])
+    np.testing.assert_array_equal(new[2], old[1])
+    assert (new[1] == 0).all() and (new[3] == 0).all()
+    np.testing.assert_allclose(new.sum(), old.sum())
+    # BN stats are copied to every child, never zeroed
+    bn_old = np.asarray(s.batch_stats["bn"]["mean"])
+    bn_new = np.asarray(out.batch_stats["bn"]["mean"])
+    for c in range(4):
+        np.testing.assert_array_equal(bn_new[c], bn_old[c // 2])
+
+
+def test_collapse_non_divisible():
+    s = _worker_state(4)
+    out = elastic.reshard_state(s, _topo(4), _topo(3), log=lambda *_: None)
+    old = np.asarray(s.memory["velocities"]["a"], np.float64)
+    new = np.asarray(out.memory["velocities"]["a"], np.float64)
+    assert new.shape == (3, 6)
+    np.testing.assert_allclose(new[0], old.sum(0), rtol=1e-5)
+    assert (new[1:] == 0).all()
+    bn = np.asarray(out.batch_stats["bn"]["mean"], np.float64)
+    want = np.asarray(s.batch_stats["bn"]["mean"], np.float64).mean(0)
+    for c in range(3):
+        np.testing.assert_allclose(bn[c], want, rtol=1e-5)
+
+
+def test_reshard_refusals():
+    s = _worker_state(4)
+    # identity is a no-op regardless of memory format
+    assert elastic.reshard_state(s, _topo(4), _topo(4)) is s
+    with pytest.raises(RuntimeError, match="num_local_workers"):
+        elastic.reshard_state(s, _topo(4, nlocal=1), _topo(2, nlocal=2))
+    with pytest.raises(NotImplementedError, match="per-worker optimizer"):
+        elastic.reshard_state(s, _topo(4), _topo(2), per_worker_opt=True)
+    weird = s.replace(memory={"surprise": np.zeros((4, 3), np.float32)})
+    with pytest.raises(ValueError, match="ELASTIC_ADDITIVE_PREFIXES"):
+        elastic.reshard_state(weird, _topo(4), _topo(2),
+                              log=lambda *_: None)
+    # a state whose leading axis does not match the recorded topology
+    with pytest.raises(ValueError, match="leading"):
+        elastic.reshard_state(s, _topo(8), _topo(2), log=lambda *_: None)
+
+
+def test_with_world_retiles_per_worker_leaves_only():
+    s = _worker_state(4)
+    t = elastic.with_world(s, 2)
+    assert np.shape(t.memory["momentums"]["a"]) == (2, 6)
+    assert np.shape(t.batch_stats["bn"]["mean"]) == (2, 3)
+    # replicated leaves keep their shape (and values)
+    np.testing.assert_array_equal(t.params["w"], s.params["w"])
+    assert np.shape(t.opt_state[0]) == ()
+
+
+# --------------------------------------------------------------------- #
+# batch geometry + fail-fast batch slicing
+# --------------------------------------------------------------------- #
+
+def test_resolve_batch_geometry():
+    assert elastic.resolve_batch_geometry(4, 4, 2) == (2, None)
+    nbps, note = elastic.resolve_batch_geometry(4, 2, 2)
+    assert nbps == 4 and "global batch" in note
+    nbps, note = elastic.resolve_batch_geometry(2, 4, 2)
+    assert nbps == 1
+    # growing beyond the nbps budget cannot preserve the product
+    with pytest.raises(RuntimeError, match="preserve_global_batch"):
+        elastic.resolve_batch_geometry(2, 8, 2)
+    with pytest.raises(RuntimeError, match="preserve_global_batch"):
+        elastic.resolve_batch_geometry(4, 3, 1)
+    # opting out keeps nbps and warns instead
+    nbps, note = elastic.resolve_batch_geometry(4, 3, 1, preserve=False)
+    assert nbps == 1 and "preserve_global_batch=False" in note
+
+
+def test_local_batch_slice_fail_fast():
+    assert local_batch_slice(64, num_processes=4, process_id=1) \
+        == slice(16, 32)
+    assert local_batch_slice(64, num_processes=1, process_id=0) \
+        == slice(0, 64)
+    with pytest.raises(ValueError) as ei:
+        local_batch_slice(65, num_processes=4, process_id=0)
+    msg = str(ei.value)
+    # actionable: names the remainder and a divisible alternative
+    assert "65" in msg and "4" in msg
+    assert "64" in msg or "68" in msg
+
+
+# --------------------------------------------------------------------- #
+# checkpoint-layer wiring
+# --------------------------------------------------------------------- #
+
+def test_checkpoint_elastic_restore_and_refusal(tmp_path, capsys):
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    saved = _worker_state(4, seed=3)
+    mgr.save(0, saved, {"m": 1.0}, topology=_topo(4))
+    assert mgr.saved_topology() == _topo(4)
+
+    template = _worker_state(2, seed=9)
+    # without elastic: explicit fail-fast that points at the flag
+    with pytest.raises(RuntimeError, match=r"elastic=True \(--elastic\)"):
+        mgr.restore(template, topology=_topo(2))
+    # with elastic: restored at world 2 with summed residuals
+    out = mgr.restore(template, topology=_topo(2), elastic=True)
+    assert out is not None
+    state, epoch, meters = out
+    assert meters["_elastic"] == {"from_world": 4, "to_world": 2,
+                                  "from_process_count": 1,
+                                  "to_process_count": 1}
+    assert "_topology" not in meters
+    old = np.asarray(saved.memory["momentums"]["a"], np.float64)
+    new = np.asarray(state.memory["momentums"]["a"], np.float64)
+    np.testing.assert_allclose(new[0], old[0] + old[1], rtol=1e-6)
+    np.testing.assert_allclose(new[1], old[2] + old[3], rtol=1e-6)
+    assert "[elastic] merging 4 workers -> 2" in capsys.readouterr().out
+
+
+def test_pre_topology_checkpoint_restores_with_warning(tmp_path, capsys):
+    """Checkpoints written before the _topology record exist in the wild:
+    they must restore as "current topology, non-elastic" with a logged
+    warning — both with and without elastic=True (satellite 2)."""
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, _worker_state(2, seed=1), {"m": 2.0})   # no topology=
+    assert mgr.saved_topology() is None
+    template = _worker_state(2, seed=9)
+    for elastic_flag in (False, True):
+        out = mgr.restore(template, topology=_topo(2),
+                          elastic=elastic_flag)
+        assert out is not None
+        _, _, meters = out
+        assert "_elastic" not in meters
+        captured = capsys.readouterr().out
+        assert "no _topology record" in captured
+        assert "current topology" in captured
+
+
+# --------------------------------------------------------------------- #
+# supervised relaunch smoke (scripts/supervise.py)
+# --------------------------------------------------------------------- #
+
+def test_supervisor_relaunch_smoke(tmp_path):
+    """End-to-end restart loop: launch 1 trains to step 3, SIGTERMs
+    itself (DGC_FAULTS=kill@3), emergency-saves with the topology record,
+    and exits 75; the supervisor counts the save as progress, relaunches,
+    and launch 2 resumes at step 4 and completes with exit 0."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    supervise = os.path.join(root, "scripts", "supervise.py")
+    worker = os.path.join(root, "tests", "elastic_worker.py")
+    events = tmp_path / "events.jsonl"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DGC_FAULTS")}
+    env["DGC_FAULTS"] = "kill@3"
+    proc = subprocess.run(
+        [sys.executable, supervise, "--retries", "3", "--backoff", "0.2",
+         "--watch", str(tmp_path / "ckpt_sup"),
+         "--events", str(events), "--",
+         sys.executable, worker, "supervised", "2", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert proc.returncode == 0, \
+        f"supervisor failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}"
+
+    lines = [json.loads(l) for l in
+             (tmp_path / "results.jsonl").read_text().splitlines()]
+    assert len(lines) == 2, lines
+    first, second = lines
+    assert first["start"] == 0 and first["completed"] is False
+    assert first["preempt_at"] == 2          # last completed step index
+    assert second["start"] == 3 and second["completed"] is True
+    assert all(np.isfinite(first["losses"] + second["losses"]))
+
+    ev = [json.loads(l) for l in events.read_text().splitlines()]
+    kinds = [e["event"] for e in ev]
+    assert kinds.count("launch") == 2
+    assert "relaunch" in kinds and kinds[-1] == "done"
+    relaunch = ev[kinds.index("relaunch")]
+    assert relaunch["rc"] == 75
+    # the emergency save counted as progress: the retry budget reset
+    assert relaunch["progressed"] is True and relaunch["failures"] == 0
+
+    # the emergency checkpoint carries the topology record (satellite 3)
+    meters = json.loads(
+        (tmp_path / "ckpt_sup" / "e0" / "meters.json").read_text())
+    assert meters["_topology"] == {"process_count": 1, "world": 2,
+                                   "num_local_workers": 1}

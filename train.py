@@ -91,6 +91,11 @@ def main():
     parser.add_argument("--profile", action="store_true",
                         help="write a device trace of the first training "
                              "steps to <save_path>/profile")
+    parser.add_argument("--elastic", action="store_true",
+                        help="allow resuming under a different world size: "
+                             "reshard the per-worker DGC state "
+                             "(docs/RESILIENCE.md §Elastic restart); same "
+                             "as stacking configs/elastic.py")
     args, opts = parser.parse_known_args()
 
     if args.cpu_mesh or args.devices == "cpu":
@@ -177,14 +182,44 @@ def main():
         axis = mesh.axis_names[0]
     world = mesh.devices.size
 
+    # elastic restart (configs/elastic.py or --elastic, docs/RESILIENCE.md):
+    # a world-size mismatch at restore reshards the per-worker state
+    # instead of failing fast, and the batch geometry below compensates
+    ecfg = configs.train.get("elastic", None)
+    elastic_on = bool(args.elastic or (ecfg and ecfg.get("enabled", False)))
+    elastic_preserve = bool(ecfg.get("preserve_global_batch", True)) \
+        if ecfg else True
+
     # two-tier runs get their own experiment dir: the error-feedback memory
     # has per-NODE semantics there — resuming a flat run's per-worker
-    # residuals (same shapes!) would silently corrupt momentum correction
+    # residuals (same shapes!) would silently corrupt momentum correction.
+    # Elastic runs drop the per-world suffix: every topology of the run
+    # must share one checkpoint lineage or there is nothing to reshard.
     tier_tag = f".tt{num_local}" if num_local > 1 else ""
+    world_tag = ".npE" if elastic_on else f".np{world}"
     configs.train.save_path = (get_save_path(*args.configs)
-                               + f"{args.suffix}{tier_tag}.np{world}")
+                               + f"{args.suffix}{tier_tag}{world_tag}")
     printr(f"[train.save_path] = {configs.train.save_path}")
     ckpt_dir = os.path.join(configs.train.save_path, "checkpoints")
+    ckpt = CheckpointManager(ckpt_dir, keep=3)
+
+    # degraded-mode batch geometry: the saved topology must be known
+    # BEFORE the global batch and LR are derived — a shrunk cohort raises
+    # num_batches_per_step so nbps * world (hence the global batch, the
+    # scaled LR, steps_per_epoch, and any mid-epoch preempt cursor) is
+    # preserved exactly
+    elastic_pending = None
+    if elastic_on:
+        from dgc_tpu.resilience import elastic as _elastic
+        saved_topo = ckpt.saved_topology()
+        if saved_topo is not None and int(saved_topo["world"]) != world:
+            new_nbps, note = _elastic.resolve_batch_geometry(
+                int(saved_topo["world"]), world,
+                configs.train.num_batches_per_step,
+                preserve=elastic_preserve)
+            if note:
+                printr(f"[elastic] {note}")
+            configs.train.num_batches_per_step = new_nbps
     printr(configs)
 
     ###########################################################
@@ -285,13 +320,27 @@ def main():
     # with a clear error instead of an opaque orbax sharding failure
     topology = {"process_count": jax.process_count(), "world": world,
                 "num_local_workers": num_local}
-    ckpt = CheckpointManager(ckpt_dir, keep=3)
+    elastic_opts = None
+    if elastic_on:
+        elastic_opts = {"per_worker_opt":
+                        getattr(dist, "per_worker_opt_state", False)}
+        if hasattr(compression, "elastic_reshard_opts"):
+            # memory semantics (momentum_masking) come from the live
+            # compressor, not a guess over the checkpoint bytes
+            elastic_opts.update(compression.elastic_reshard_opts())
     last_epoch, best_metric = -1, None
-    restored = ckpt.restore(state, best=args.evaluate, topology=topology) if (
+    restored = ckpt.restore(state, best=args.evaluate, topology=topology,
+                            elastic=elastic_on,
+                            elastic_opts=elastic_opts) if (
         ckpt.latest_epoch() is not None or args.evaluate) else None
     resume_epoch, resume_batch = None, 0
     if restored is not None:
         host_state, last_epoch, meters = restored
+        einfo = meters.pop("_elastic", None)
+        if einfo is not None:
+            printr(f"[elastic] resharded checkpoint state "
+                   f"{einfo['from_world']} -> {einfo['to_world']} workers")
+            elastic_pending = dict(einfo, epoch=last_epoch)
         if guards_cfg is not None and host_state.guards is None:
             # pre-resilience checkpoint: re-seed fresh guard counters
             # (deterministic zeros — identical on every process)
@@ -299,12 +348,15 @@ def main():
             host_state = host_state.replace(
                 guards=jax.tree.map(np.asarray,
                                     _guard.init_state(guards_cfg)))
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and einfo is None:
             # multi-host restore already produced global sharded arrays
             # placed by the template's shardings — no re-shard possible
             # (host materialization of non-addressable arrays would throw)
             state = host_state
         else:
+            # single-process restore, or an elastic restore (which hands
+            # back HOST numpy state: shard_state's multi-process path
+            # assembles the global arrays collective-free)
             state = shard_state(jax.tree.map(jnp.asarray, host_state), mesh,
                                 axis, dist_opt=dist)
         best_metric = meters.get(configs.train.metric + "_best")
@@ -374,6 +426,12 @@ def main():
             enabled=jax.process_index() == 0,
             guards=guards_cfg is not None)
         printr(f"[telemetry] -> {sink.path or '(non-coordinator)'}")
+        if elastic_pending is not None:
+            # the restore resharded across a topology change: record it
+            # in the telemetry stream so readers can re-anchor per-worker
+            # columns (same pattern as the engine_rebuild event)
+            sink.write_record(dict(elastic_pending,
+                                   event="elastic_restart"))
 
     # host-side resilience: signal -> flag (the loop does the emergency
     # save at a step boundary); watchdog dumps stacks on a stalled step
@@ -538,7 +596,11 @@ def main():
             emeters = {"preempt_batch": preempt_at}
             if best_metric is not None:
                 emeters[configs.train.metric + "_best"] = best_metric
-            path = ckpt.save(epoch, state, emeters, topology=topology)
+            # emergency_save stamps _topology unconditionally: an elastic
+            # restart of THIS checkpoint is exactly the case where the
+            # record must exist
+            path = _preempt.emergency_save(ckpt, epoch, state, emeters,
+                                           topology=topology)
             printr(f"[preempt] emergency checkpoint -> {path}")
 
     if sink is not None:
@@ -550,6 +612,10 @@ def main():
         handler.uninstall()
     if preempted:
         _preempt.clean_shutdown()
+        # EX_TEMPFAIL: tell a supervisor (scripts/supervise.py) this was
+        # a clean preemption with the emergency save already on disk —
+        # relaunch (a plain 0 would read as "training finished")
+        raise SystemExit(75)
 
 
 if __name__ == "__main__":
