@@ -280,6 +280,51 @@ def main():
     print(f"per-round overheads: {[round(x, 3) for x in diffs]} "
           f"-> median {overhead:.4f} ms", file=sys.stderr)
 
+    # DGC_TRACE_AB=1: device-profile both arms with dgcph.* phase markers
+    # on (fresh builds — the timing arms above compiled marker-free) and
+    # write the per-bucket per-phase cost table to DGC_TRACE_OUT; the
+    # profiled dgc-minus-dense delta reconciles against the paired median
+    # above (docs/TELEMETRY.md §Phase attribution)
+    if os.environ.get("DGC_TRACE_AB", "") == "1":
+        from dgc_tpu.telemetry import attrib
+        from dgc_tpu.telemetry import trace as dgc_trace
+        out = os.environ.get("DGC_TRACE_OUT", "runs/profile.json")
+        logroot = os.environ.get("DGC_TRACE_DIR", "/tmp/dgc_trace_ab")
+        ev = {}
+        prev = dgc_trace.enable(True)
+        try:
+            for name, dist in (
+                    ("dgc", DistributedOptimizer(
+                        dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4),
+                        comp, world_size=W)),
+                    ("dense", DistributedOptimizer(
+                        sgd(0.1, momentum=0.9, weight_decay=1e-4),
+                        Compression.none(), world_size=W))):
+                (loop, state), _ = prepare(dist)
+                state, _ = loop(state, jax.random.PRNGKey(0))  # warm
+                float(_ssum(state.params))
+                logdir = os.path.join(logroot, name)
+                os.makedirs(logdir, exist_ok=True)
+                with jax.profiler.trace(logdir):
+                    state, _ = loop(state, jax.random.PRNGKey(1))
+                    float(_ssum(state.params))
+                ev[name] = attrib.device_events(
+                    attrib.load_trace_events(logdir))
+        finally:
+            dgc_trace.enable(prev)
+        prof = attrib.profile_json(
+            attrib.phase_table(ev["dgc"], steps=K_STEPS),
+            attrib.phase_table(ev["dense"], steps=K_STEPS),
+            static={"model": "resnet20", "ratio": 0.001, "world": W,
+                    "k": K_STEPS,
+                    "wire_bytes": dgc_setup.engine.wire_bytes_per_worker(),
+                    "payload_elems": dgc_setup.engine.payload_size},
+            measured_overhead_ms=overhead)
+        print(f"trace-ab profile -> {attrib.write_profile(prof, out)} "
+              f"(delta {prof['delta_ms']:.3f} ms, exchange phases "
+              f"{prof['exchange_phase_ms']:.3f} ms, measured "
+              f"{overhead:.3f} ms)", file=sys.stderr)
+
     # --- exchange model, both fabric regimes ---
     P_total = dgc_setup.layout.num_params
     payload = dgc_setup.engine.payload_size

@@ -41,3 +41,12 @@ configs.train.resilience.checksum = False
 configs.train.resilience.watchdog_secs = 300
 # SIGTERM/SIGINT -> atomic full-state checkpoint before shutdown
 configs.train.resilience.emergency_checkpoint = True
+# crash flight recorder: ring of the last N step records (step, loss,
+# span timings, last checkpoint epoch), dumped atomically to
+# <save_path>/flight.json on watchdog stall, preemption exit, or
+# nonfinite-streak abort (0 disables the recorder)
+configs.train.resilience.flight_steps = 256
+# abort (with a flight dump) after this many CONSECUTIVE nonfinite
+# drained losses — the run is unrecoverable past the guards' skip
+# horizon; 0 disables the breaker
+configs.train.resilience.nonfinite_streak = 3

@@ -1,0 +1,27 @@
+"""Tracing knob (docs/TELEMETRY.md §Tracing): append to any config stack
+to turn structured tracing on:
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/trace.py
+
+What it enables:
+* host-side spans (data load, step dispatch, exchange wait, checkpoint,
+  eval) streamed through the async telemetry sink and saved as a
+  Perfetto-loadable Chrome trace at <save_path>/trace.json;
+* device-side ``dgcph.<phase>[.b<bucket>]`` named-scope markers through
+  the DGC pipeline (compensate/threshold/select/pack/allgather/decode/
+  apply) — pure op metadata, zero new ops or collectives; a device
+  profile then attributes per-bucket per-phase cost via
+  dgc_tpu.telemetry.attrib.
+
+With this module absent the markers compile away byte-identically (the
+``trace-off-compiles-away`` contract in dgc_tpu/analysis/suite.py).
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.trace = Config()
+configs.train.trace.enabled = True
+# cap on in-memory host spans retained for the end-of-run trace.json
+# (the sink JSONL keeps everything regardless)
+configs.train.trace.max_events = 65536

@@ -99,6 +99,8 @@ class Contract:
                 forbid_ops: Optional[Sequence[str]] = None,
                 require_ops: Optional[Sequence[str]] = None,
                 forbid_substrings: Optional[Sequence[str]] = None,
+                forbid_substrings_compiled: Optional[Sequence[str]] = None,
+                require_substrings_compiled: Optional[Sequence[str]] = None,
                 no_f64: bool = False,
                 identical_to: Optional["Contract"] = None,
                 collectives_delta: Optional[
@@ -114,6 +116,10 @@ class Contract:
             at-least-one occurrences in the lowered module.
         forbid_substrings: raw substrings that must not appear in the
             lowered text (e.g. ``"telemetry"`` op metadata).
+        forbid_substrings_compiled / require_substrings_compiled: same,
+            against the COMPILED module text — named-scope markers live
+            only in compiled op metadata (``op_name=...``), not in the
+            default lowered StableHLO (the trace-contract pins).
         no_f64: no f64 tensor type anywhere in the lowered module.
         identical_to: another Contract whose lowered text must match
             byte-for-byte (the telemetry-off == never-built pin).
@@ -136,6 +142,12 @@ class Contract:
         for s in (forbid_substrings or ()):
             self._expectations.append(
                 lambda s=s: self._check_substring(s))
+        for s in (forbid_substrings_compiled or ()):
+            self._expectations.append(
+                lambda s=s: self._check_substring_compiled(s, forbid=True))
+        for s in (require_substrings_compiled or ()):
+            self._expectations.append(
+                lambda s=s: self._check_substring_compiled(s, forbid=False))
         if no_f64:
             self._expectations.append(self._check_no_f64)
         if identical_to is not None:
@@ -184,6 +196,16 @@ class Contract:
         if n:
             return [f"forbidden substring {s!r}: {n} occurrence(s) in "
                     "lowered module"]
+        return []
+
+    def _check_substring_compiled(self, s: str, forbid: bool) -> List[str]:
+        n = self.compiled_text.count(s)
+        if forbid and n:
+            return [f"forbidden substring {s!r}: {n} occurrence(s) in "
+                    "compiled module"]
+        if not forbid and not n:
+            return [f"required substring {s!r}: absent from compiled "
+                    "module"]
         return []
 
     def _check_no_f64(self) -> List[str]:

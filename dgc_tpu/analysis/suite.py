@@ -19,6 +19,10 @@ tests exercise:
   resilience/guard or resilience/preempt code); guards=on (+ checksum)
   adds ZERO collectives — the bad-worker verdict rides the existing loss
   all-reduce and the checksum words ride the existing index all-gather.
+* **trace markers are free**: trace=off (default) is byte-identical to
+  the plain build with no ``dgcph`` token in the compiled module;
+  trace=on adds ZERO collectives while the ``dgcph.*`` phase markers
+  land in compiled op metadata (what telemetry/attrib aggregates).
 * **elastic restart is free when off**: elastic resharding is restore-
   time host code — a step whose batch geometry went through
   ``resolve_batch_geometry`` (identity) is byte-identical to the plain
@@ -213,6 +217,37 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         collectives_delta=(plain, {"all-reduce": 0, "all-gather": 0}),
         no_f64=True)
     run(gon.name, gon.check)
+
+    # trace markers: lowering a fresh build while the phase markers are
+    # ENABLED must add zero collectives (named scopes are pure metadata)
+    # and the dgcph tokens must actually reach the compiled op metadata
+    # (markers live in compiled op_name=..., not default StableHLO — so
+    # this pin reads compiled text). Lowering is lazy: check() must run
+    # INSIDE the enable window.
+    from dgc_tpu.telemetry import trace as _tr
+    prev_tr = _tr.enable(True)
+    try:
+        _, step_tron, _, _ = build_fixture(mesh, donate=False,
+                                           telemetry=False)
+        tron = _step_contract(
+            "trace-on-no-new-collectives", state, step_tron, inputs,
+            collectives_delta=(plain, {"all-reduce": 0, "all-gather": 0}),
+            require_substrings_compiled=["dgcph."], no_f64=True)
+        run(tron.name, tron.check)
+    finally:
+        _tr.enable(prev_tr)
+
+    # trace off (the default): a fresh build after disable is
+    # byte-identical to the plain build — phase() is Python-static, not a
+    # traced no-op — and no dgcph token survives anywhere in the
+    # compiled module
+    _, step_troff, _, _ = build_fixture(mesh, donate=False,
+                                        telemetry=False)
+    troff = _step_contract(
+        "trace-off-compiles-away", state, step_troff, inputs,
+        forbid_substrings_compiled=["dgcph."],
+        identical_to=plain)
+    run(troff.name, troff.check)
 
     # elastic=False must cost nothing: resharding lives entirely in the
     # restore path (resilience/elastic.py is host numpy), so a step built

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from dgc_tpu.compression.base import CompressCtx, Compressor
 from dgc_tpu.compression.memory import Memory
 from dgc_tpu.ops import sparsify as ops
+from dgc_tpu.telemetry import trace as _trace
 
 __all__ = ["DGCCompressor", "TensorAttrs", "sampling_geometry"]
 
@@ -308,21 +309,24 @@ class DGCCompressor(Compressor):
         else:
             samples = ops.uniform_sample(importance, attrs.num_samples, key)
 
-        threshold = ops.topk_threshold(samples, attrs.top_k_samples)
-        if attrs.numel > attrs.num_samples:
-            threshold = ops.adapt_threshold(
-                importance, threshold, attrs.num_selects,
-                self.compress_lower_bound, self.compress_upper_bound,
-                self.max_adaptation_iters, self.resample)
-        return ops.select_by_threshold(flat, importance, threshold,
-                                       attrs.num_selects)
+        with _trace.phase("threshold"):
+            threshold = ops.topk_threshold(samples, attrs.top_k_samples)
+            if attrs.numel > attrs.num_samples:
+                threshold = ops.adapt_threshold(
+                    importance, threshold, attrs.num_selects,
+                    self.compress_lower_bound, self.compress_upper_bound,
+                    self.max_adaptation_iters, self.resample)
+        with _trace.phase("select"):
+            return ops.select_by_threshold(flat, importance, threshold,
+                                           attrs.num_selects)
 
     def compress(self, mem_state, name: str, grad, key):
         """Momentum-corrected sparsification (compression.py:155-177)."""
         if self.compress_ratio < 1.0 and name in self.attributes:
             attrs = self.attributes[name]
-            compensated, mem_state = self.memory.compensate(
-                mem_state, name, grad, accumulate=True)
+            with _trace.phase("compensate"):
+                compensated, mem_state = self.memory.compensate(
+                    mem_state, name, grad, accumulate=True)
             values, indices, valid = self.sparsify(compensated, name, key)
             mem_state = self.memory.update(mem_state, name, indices, valid)
             ctx = CompressCtx(name=name, numel=attrs.numel, shape=attrs.shape,
@@ -331,7 +335,8 @@ class DGCCompressor(Compressor):
                 # per-TENSOR scale: payload magnitudes differ by orders
                 # of magnitude across layers, a global scale would crush
                 # the small ones
-                q, scale = quantize_int8(values)
+                with _trace.phase("pack"):
+                    q, scale = quantize_int8(values)
                 if self.int8_error_feedback:
                     # what was actually transmitted is q*scale; put the
                     # rounding residual back into the velocity the
@@ -363,8 +368,11 @@ class DGCCompressor(Compressor):
         if ctx.compressed:
             # (values, indices) or (q, indices, scale) under int8_values —
             # gather every component (the scale is one f32 per worker)
-            return tuple(jax.lax.all_gather(p, axis_name) for p in payload)
-        return jax.lax.psum(payload, axis_name)
+            with _trace.phase("allgather"):
+                return tuple(jax.lax.all_gather(p, axis_name)
+                             for p in payload)
+        with _trace.phase("dense"):
+            return jax.lax.psum(payload, axis_name)
 
     def exchange_fused(self, compressed, axis_name: str, world_size: int,
                        mem_state):
@@ -383,13 +391,14 @@ class DGCCompressor(Compressor):
         sizes = [compressed[n][0][0].shape[0] for n in names]
         all_values = jnp.concatenate([compressed[n][0][0] for n in names])
         all_indices = jnp.concatenate([compressed[n][0][1] for n in names])
-        g_values = jax.lax.all_gather(all_values, axis_name)
-        g_indices = jax.lax.all_gather(all_indices, axis_name)
-        g_scales = None
-        if self.int8_values:
-            # one f32 scale per tensor rides as a single [n_tensors] vector
-            all_scales = jnp.stack([compressed[n][0][2] for n in names])
-            g_scales = jax.lax.all_gather(all_scales, axis_name)  # [W, n]
+        with _trace.phase("allgather"):
+            g_values = jax.lax.all_gather(all_values, axis_name)
+            g_indices = jax.lax.all_gather(all_indices, axis_name)
+            g_scales = None
+            if self.int8_values:
+                # one f32 scale per tensor rides as one [n_tensors] vector
+                all_scales = jnp.stack([compressed[n][0][2] for n in names])
+                g_scales = jax.lax.all_gather(all_scales, axis_name)
         out = {}
         offset = 0
         for i, (n, sz) in enumerate(zip(names, sizes)):
@@ -415,16 +424,18 @@ class DGCCompressor(Compressor):
         if ctx.compressed:
             if self.int8_values:
                 q, indices, scales = gathered   # [W,k], [W,k], [W]
-                values = q.astype(ctx.dtype) * scales[:, None].astype(
-                    ctx.dtype)
+                with _trace.phase("decode"):
+                    values = q.astype(ctx.dtype) * scales[:, None].astype(
+                        ctx.dtype)
             else:
                 values, indices = gathered      # [W, num_selects] each
                 if self.fp16_values:
                     values = values.astype(ctx.dtype)
-            dense = ops.scatter_add_dense(ctx.numel, indices, values,
-                                          dtype=ctx.dtype)
-            if avg:
-                dense = dense / world_size      # hvd.Average semantics
+            with _trace.phase("apply"):
+                dense = ops.scatter_add_dense(ctx.numel, indices, values,
+                                              dtype=ctx.dtype)
+                if avg:
+                    dense = dense / world_size  # hvd.Average semantics
             return dense.reshape(ctx.shape), mem_state
         else:
             grad = gathered

@@ -46,6 +46,7 @@ from dgc_tpu.compression.memory import DGCSGDMemory
 from dgc_tpu.ops import kernels
 from dgc_tpu.resilience import faults as _faults
 from dgc_tpu.resilience import integrity
+from dgc_tpu.telemetry import trace as _trace
 from dgc_tpu.utils.pytree import named_flatten, named_unflatten
 
 __all__ = ["ParamLayout", "FlatDGCEngine", "FlatDenseExchange"]
@@ -1328,9 +1329,11 @@ class FlatDGCEngine:
             k = jax.random.fold_in(key, bi)
             if self._use_seg_kernel(b) or self._use_3d(b):
                 # layout-free selection — no 2-D relayout of the bucket
-                vals, gidx = self._sparsify_bucket_3d(vec_c, v2d, b, k,
-                                                      cands=seg_cands)
-                emit(vals, gidx, b)
+                with _trace.phase("select", bi):
+                    vals, gidx = self._sparsify_bucket_3d(vec_c, v2d, b, k,
+                                                          cands=seg_cands)
+                with _trace.phase("pack", bi):
+                    emit(vals, gidx, b)
                 continue
             R = b.rows
             row_off = jnp.asarray(b.row_offsets,
@@ -1355,21 +1358,25 @@ class FlatDGCEngine:
                 # pass below. Skip the redundant sampling/threshold pass
                 # (adaptation is statically off: numel == num_samples).
                 scores = imp_rows
-                top_scores, cols = self._select_topk(scores, b.max_sel)
-                slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
-                valid = (top_scores >= 0) & (
-                    slot < jnp.asarray(b.num_selects)[:, None])
-                gidx = jnp.where(valid,
-                             row_off + cols.astype(self.index_dtype),
-                             jnp.asarray(S, self.index_dtype))
-                vals = jnp.where(valid,
-                                 jnp.take_along_axis(block, cols, axis=1),
-                                 jnp.zeros((), vec_c.dtype))
-                emit(vals, gidx, b)
+                with _trace.phase("select", bi):
+                    top_scores, cols = self._select_topk(scores, b.max_sel)
+                    slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
+                    valid = (top_scores >= 0) & (
+                        slot < jnp.asarray(b.num_selects)[:, None])
+                    gidx = jnp.where(valid,
+                                 row_off + cols.astype(self.index_dtype),
+                                 jnp.asarray(S, self.index_dtype))
+                    vals = jnp.where(valid,
+                                     jnp.take_along_axis(block, cols,
+                                                         axis=1),
+                                     jnp.zeros((), vec_c.dtype))
+                with _trace.phase("pack", bi):
+                    emit(vals, gidx, b)
                 continue
 
             # --- sampling positions (reference compression.py:113-121) ---
-            samples = self._sample_rows(b, imp_rows, k)
+            with _trace.phase("threshold", bi):
+                samples = self._sample_rows(b, imp_rows, k)
 
             # --- per-row sampled threshold (compression.py:123) ---
             # the threshold is a QUANTILE ESTIMATE over an already-random
@@ -1380,15 +1387,16 @@ class FlatDGCEngine:
             # bounded ladder adaptation corrects, and on CPU it lowers to
             # the exact sort (equivalence tests unchanged)
             r = self.c.approx_recall
-            if r is not None and (b.max_k > 128
-                                  or b.max_k * b.max_s > 2_000_000):
-                sorted_s = jax.lax.approx_max_k(samples, b.max_k,
-                                                recall_target=float(r))[0]
-            else:
-                sorted_s = _exact_topk(samples, b.max_k)[0]
-            thr = jnp.take_along_axis(
-                sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
-                axis=1)[:, 0]
+            with _trace.phase("threshold", bi):
+                if r is not None and (b.max_k > 128
+                                      or b.max_k * b.max_s > 2_000_000):
+                    sorted_s = jax.lax.approx_max_k(
+                        samples, b.max_k, recall_target=float(r))[0]
+                else:
+                    sorted_s = _exact_topk(samples, b.max_k)[0]
+                thr = jnp.take_along_axis(
+                    sorted_s, jnp.asarray(b.topk_samples)[:, None] - 1,
+                    axis=1)[:, 0]
 
             # --- fixed-size selection (ops.select_by_threshold semantics) ---
             # top-k over RAW importance, below-threshold slots invalidated
@@ -1400,38 +1408,46 @@ class FlatDGCEngine:
             # Selection runs BEFORE threshold adaptation (it does not
             # depend on thr), so the resample ladder can be derived from
             # the top-k values with no extra pass over the block.
-            top_scores, cols = self._select_topk(imp_rows, b.max_sel)
+            with _trace.phase("select", bi):
+                top_scores, cols = self._select_topk(imp_rows, b.max_sel)
 
             # --- bounded threshold adaptation (compression.py:128-149) ---
             if self.c.max_adaptation_iters > 0 and b.adapt.any():
-                if self.c.resample:
-                    # exact ladder choice from the selection's own top-k —
-                    # replaces the full [R, cols] ladder-counts scan (see
-                    # _ladder_adapt_from_topk for the equality argument)
-                    thr = _ladder_adapt_from_topk(
-                        top_scores, thr,
-                        jnp.asarray(b.num_selects, jnp.float32),
-                        jnp.asarray(b.adapt), self.c.compress_lower_bound,
-                        self.c.max_adaptation_iters)
-                else:
-                    thr = _batched_adapt(
-                        imp_rows, thr,
-                        jnp.asarray(b.num_selects, jnp.float32),
-                        jnp.asarray(b.adapt), self.c.compress_lower_bound,
-                        self.c.compress_upper_bound,
-                        self.c.max_adaptation_iters, self.c.resample)
-            slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
-            valid = (top_scores >= thr[:, None]) & (
-                slot < jnp.asarray(b.num_selects)[:, None])
-            gidx = jnp.where(valid,
-                             row_off + cols.astype(self.index_dtype),
-                             jnp.asarray(S, self.index_dtype))
-            # values via a row-local gather from the reshape view (no
-            # global gather); invalid slots carry 0.0 like the sentinel
-            vals = jnp.where(valid, jnp.take_along_axis(block, cols, axis=1),
-                             jnp.zeros((), vec_c.dtype))
+                with _trace.phase("threshold", bi):
+                    if self.c.resample:
+                        # exact ladder choice from the selection's own
+                        # top-k — replaces the full [R, cols]
+                        # ladder-counts scan (see _ladder_adapt_from_topk
+                        # for the equality argument)
+                        thr = _ladder_adapt_from_topk(
+                            top_scores, thr,
+                            jnp.asarray(b.num_selects, jnp.float32),
+                            jnp.asarray(b.adapt),
+                            self.c.compress_lower_bound,
+                            self.c.max_adaptation_iters)
+                    else:
+                        thr = _batched_adapt(
+                            imp_rows, thr,
+                            jnp.asarray(b.num_selects, jnp.float32),
+                            jnp.asarray(b.adapt),
+                            self.c.compress_lower_bound,
+                            self.c.compress_upper_bound,
+                            self.c.max_adaptation_iters, self.c.resample)
+            with _trace.phase("select", bi):
+                slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
+                valid = (top_scores >= thr[:, None]) & (
+                    slot < jnp.asarray(b.num_selects)[:, None])
+                gidx = jnp.where(valid,
+                                 row_off + cols.astype(self.index_dtype),
+                                 jnp.asarray(S, self.index_dtype))
+                # values via a row-local gather from the reshape view (no
+                # global gather); invalid slots carry 0.0 like the sentinel
+                vals = jnp.where(valid,
+                                 jnp.take_along_axis(block, cols, axis=1),
+                                 jnp.zeros((), vec_c.dtype))
 
-            emit(vals, gidx, b)
+            with _trace.phase("pack", bi):
+                emit(vals, gidx, b)
         if stats_out is not None:
             # telemetry tap over the emitted payload (no extra HBM pass —
             # the payload-sized arrays are already live): per-bucket real
@@ -1606,9 +1622,10 @@ class FlatDGCEngine:
             # transmit record is applied on read inside the compensate
             # pass. x*0 == set-to-0 for finite values, and the sentinel
             # slot is a structural zero, so padded payload slots are no-ops.
-            comp, mc, vc, cands = self._compensate_acc(
-                mc, vc, gsrc, mem["sent_bits"],
-                want_cands=self._seg_fused)
+            with _trace.phase("compensate"):
+                comp, mc, vc, cands = self._compensate_acc(
+                    mc, vc, gsrc, mem["sent_bits"],
+                    want_cands=self._seg_fused)
         else:
             comp = gc
         sel_stats: Optional[Dict] = {} if telemetry else None
@@ -1623,12 +1640,13 @@ class FlatDGCEngine:
             # reference's stated "no quantization/encoding of payloads"
             # caveat (README.md:130-138) addressed; dequantize after the
             # gather, before the scatter-add
-            smax = jax.ops.segment_max(jnp.abs(values), self._row_map,
-                                       num_segments=self.payload_rows)
-            scale = (smax / 127.0).astype(jnp.float32)
-            safe = jnp.where(scale > 0, scale, 1.0)
-            q = jnp.clip(jnp.round(values / safe[self._row_map]),
-                         -127, 127).astype(jnp.int8)
+            with _trace.phase("pack"):
+                smax = jax.ops.segment_max(jnp.abs(values), self._row_map,
+                                           num_segments=self.payload_rows)
+                scale = (smax / 127.0).astype(jnp.float32)
+                safe = jnp.where(scale > 0, scale, 1.0)
+                q = jnp.clip(jnp.round(values / safe[self._row_map]),
+                             -127, 127).astype(jnp.int8)
             int8_ef = (m is not None
                        and getattr(self.c, "int8_error_feedback", False))
             if int8_ef:
@@ -1650,15 +1668,18 @@ class FlatDGCEngine:
                 vc = vc.at[indices].add(-dequant)
                 if m.momentum_masking:
                     mc = mc.at[indices].set(jnp.zeros((), mc.dtype))
-            g_q = jax.lax.all_gather(q, axis_name)          # [W, payload]
-            g_scales = jax.lax.all_gather(scale, axis_name)  # [W, rows]
-            g_values = g_q.astype(dt) * jnp.take(
-                g_scales.astype(dt), self._row_map, axis=1)
+            with _trace.phase("allgather"):
+                g_q = jax.lax.all_gather(q, axis_name)       # [W, payload]
+                g_scales = jax.lax.all_gather(scale, axis_name)  # [W, rows]
+            with _trace.phase("decode"):
+                g_values = g_q.astype(dt) * jnp.take(
+                    g_scales.astype(dt), self._row_map, axis=1)
         else:
             wire_values = (values.astype(jnp.float16)
                            if self.c.fp16_values else values)
-            g_values = jax.lax.all_gather(wire_values,
-                                          axis_name)        # [W, payload]
+            with _trace.phase("allgather"):
+                g_values = jax.lax.all_gather(wire_values,
+                                              axis_name)    # [W, payload]
         if _faults.armed():
             # deterministic post-gather corruption (tests only; identity
             # ops, zero HLO, when DGC_FAULTS is unset)
@@ -1669,35 +1690,44 @@ class FlatDGCEngine:
             # the value words as shipped, and the indices in the form the
             # receiver reconstructs (codec slots clip in-row — see
             # IndexCodec.canonical). Rides the index gather below.
-            idx_canon = (self._codec.canonical(indices)
-                         if self._codec is not None else indices)
-            chk = integrity.payload_checksum(
-                wire_values, idx_canon, self._seg_ids, len(self.buckets))
+            with _trace.phase("pack"):
+                idx_canon = (self._codec.canonical(indices)
+                             if self._codec is not None else indices)
+                chk = integrity.payload_checksum(
+                    wire_values, idx_canon, self._seg_ids,
+                    len(self.buckets))
         if self._codec is not None:
             # packed index wire: gather the bitstream, decode per worker
             # (static gathers + shifts; decoded == original for every
             # real slot, padded slots land in-row with value 0.0)
-            words = self._codec.encode(indices)
-            if checksum:
-                # int32 -> uint32 astype is a bit-preserving mod-2^32
-                # wrap, undone symmetrically on the receiver
-                words = jnp.concatenate([words, chk.astype(jnp.uint32)])
-            g_words = jax.lax.all_gather(words, axis_name)
-            if checksum:
-                g_chk = g_words[:, self._codec.nwords:].astype(jnp.int32)
-                g_words = g_words[:, :self._codec.nwords]
-            g_indices = self._codec.decode(g_words, self.index_dtype)
+            with _trace.phase("pack"):
+                words = self._codec.encode(indices)
+                if checksum:
+                    # int32 -> uint32 astype is a bit-preserving mod-2^32
+                    # wrap, undone symmetrically on the receiver
+                    words = jnp.concatenate([words, chk.astype(jnp.uint32)])
+            with _trace.phase("allgather"):
+                g_words = jax.lax.all_gather(words, axis_name)
+            with _trace.phase("decode"):
+                if checksum:
+                    g_chk = g_words[:, self._codec.nwords:].astype(jnp.int32)
+                    g_words = g_words[:, :self._codec.nwords]
+                g_indices = self._codec.decode(g_words, self.index_dtype)
         else:
-            idx_wire = indices
-            if checksum:
-                idx_wire = jnp.concatenate(
-                    [indices, chk.astype(self.index_dtype)])
-            g_idx_wire = jax.lax.all_gather(idx_wire, axis_name)
-            if checksum:
-                g_chk = g_idx_wire[:, self.payload_size:].astype(jnp.int32)
-                g_indices = g_idx_wire[:, :self.payload_size]
-            else:
-                g_indices = g_idx_wire
+            with _trace.phase("pack"):
+                idx_wire = indices
+                if checksum:
+                    idx_wire = jnp.concatenate(
+                        [indices, chk.astype(self.index_dtype)])
+            with _trace.phase("allgather"):
+                g_idx_wire = jax.lax.all_gather(idx_wire, axis_name)
+            with _trace.phase("decode"):
+                if checksum:
+                    g_chk = g_idx_wire[:, self.payload_size:].astype(
+                        jnp.int32)
+                    g_indices = g_idx_wire[:, :self.payload_size]
+                else:
+                    g_indices = g_idx_wire
         if _faults.armed():
             g_indices = _faults.corrupt_indices(g_indices)
         if checksum:
@@ -1712,10 +1742,11 @@ class FlatDGCEngine:
         # construction); the codec path additionally enforces each
         # slot's static row bounds — exactly the set an honest encode
         # can produce. Honest traffic passes through bitwise unchanged.
-        g_indices = integrity.clamp_indices(
-            g_indices, T, self.layout.sentinel,
-            *((self._codec.slot_off, self._codec.slot_numel)
-              if self._codec is not None else (None, None)))
+        with _trace.phase("decode"):
+            g_indices = integrity.clamp_indices(
+                g_indices, T, self.layout.sentinel,
+                *((self._codec.slot_off, self._codec.slot_numel)
+                  if self._codec is not None else (None, None)))
         # Averaging divides the [W, payload] WIRE values BEFORE the
         # scatter (algebraically identical to the reference's
         # scatter-then-divide, compression.py:192-193; differs by
@@ -1746,16 +1777,19 @@ class FlatDGCEngine:
             # local indices; the dead previous-step record buffer is
             # donated for the rebuild (input_output_aliases). Values
             # within f32 scatter-order rounding of the XLA path below.
-            me = jax.lax.axis_index(axis_name)
-            rows = jnp.arange(g_indices.shape[0],
-                              dtype=jnp.int32)[:, None]
-            flags = ((rows == me)
-                     & (g_indices != self.layout.sentinel)).reshape(-1)
-            acc, new_bits = kernels.payload_apply_bits(
-                wire, g_indices.reshape(-1), flags, T,
-                bits_donor=mem["sent_bits"])
+            with _trace.phase("apply"):
+                me = jax.lax.axis_index(axis_name)
+                rows = jnp.arange(g_indices.shape[0],
+                                  dtype=jnp.int32)[:, None]
+                flags = ((rows == me)
+                         & (g_indices != self.layout.sentinel)).reshape(-1)
+                acc, new_bits = kernels.payload_apply_bits(
+                    wire, g_indices.reshape(-1), flags, T,
+                    bits_donor=mem["sent_bits"])
         else:
-            acc = jnp.zeros((T,), dt).at[g_indices.reshape(-1)].add(wire)
+            with _trace.phase("apply"):
+                acc = jnp.zeros((T,),
+                                dt).at[g_indices.reshape(-1)].add(wire)
             if m is not None:
                 # THIS step's transmit record for the next compensate:
                 # bit-packed, one word-wide scatter over a 32x smaller
@@ -1764,18 +1798,22 @@ class FlatDGCEngine:
                 # bits). Under int8 error feedback the record stays empty
                 # — masking was applied eagerly above and the velocity
                 # keeps the residual.
-                new_bits = (jnp.zeros_like(mem["sent_bits"]) if int8_ef
-                            else kernels.pack_sent_bits(
-                                indices, T, sentinel=self.layout.sentinel))
+                with _trace.phase("pack"):
+                    new_bits = (jnp.zeros_like(mem["sent_bits"]) if int8_ef
+                                else kernels.pack_sent_bits(
+                                    indices, T,
+                                    sentinel=self.layout.sentinel))
 
         # --- dense fallback block: one collective + correction ---
         if P > T:
-            gd_avg = self._dense_combine(gd, axis_name, world_size, op)
-            if clip is not None:
-                # the fallback's compensate sees the AVERAGED gradient
-                # (reference compression.py:198 -> memory.py:52-53)
-                gd_avg = self._clip_block(gd_avg, self.layout.dense_names, T)
-            out_d, md = self._compensate_dense(md, gd_avg)
+            with _trace.phase("dense"):
+                gd_avg = self._dense_combine(gd, axis_name, world_size, op)
+                if clip is not None:
+                    # the fallback's compensate sees the AVERAGED gradient
+                    # (reference compression.py:198 -> memory.py:52-53)
+                    gd_avg = self._clip_block(gd_avg,
+                                              self.layout.dense_names, T)
+                out_d, md = self._compensate_dense(md, gd_avg)
             out = jnp.concatenate([acc, out_d])
         else:
             out = acc

@@ -35,6 +35,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# phase markers (telemetry.trace): applied only to the INLINE-traced
+# entrypoints below. The module-level jitted kernels
+# (fused_compensate_bits[_cands]) must NOT carry a marker inside their
+# jit — the nested-jit jaxpr cache doesn't key on the trace flag, so a
+# marker baked there would leak across trace-on/off builds and break the
+# trace-off-compiles-away byte-identity contract. Their call sites in
+# compression/flat.py wrap them in phase("compensate") instead; the
+# caller's name stack prefixes nested-jit op names, so attribution sees
+# them either way.
+from dgc_tpu.telemetry import trace as _trace
+
 __all__ = ["fused_compensate", "fused_compensate_reference",
            "fused_compensate_masked", "fused_compensate_masked_reference",
            "fused_compensate_bits", "fused_compensate_bits_reference",
@@ -277,6 +288,7 @@ def num_sent_words(total: int) -> int:
     return -(-total // _BITS_GROUP) * _LANE
 
 
+@_trace.phased("pack")
 def pack_sent_bits(indices: jax.Array, total: int,
                    sentinel=None) -> jax.Array:
     """Transmit indices -> packed one-bit-per-coordinate record.
@@ -598,6 +610,7 @@ def _topk_kernel(x_ref, v_ref, i_ref, *, k, cols):
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
+@_trace.phased("select")
 def topk_rows(x: jax.Array, k: int):
     """Per-row ``(values, indices)`` of the k largest elements, identical to
     ``jax.lax.top_k`` (descending values, ties broken by first occurrence)
@@ -1021,6 +1034,7 @@ def _payload_apply_kernel(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
     jax.lax.fori_loop(0, cnt_ref[p], body, 0)
 
 
+@_trace.phased("apply")
 def payload_apply_bits(values, indices, flags, total: int,
                        bits_donor=None):
     """Fused apply epilogue: decompress scatter-add + transmit-record

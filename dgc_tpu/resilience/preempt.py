@@ -71,11 +71,15 @@ class Watchdog:
 
     ``sink`` — optional TelemetrySink (its ``flush()`` drains the async
     queue so the last records hit disk before the scheduler reaps us).
+    ``flight``/``flight_path`` — optional telemetry.flight.FlightRecorder:
+    a stall atomically dumps the recent-step ring to ``flight_path`` (the
+    postmortem artifact; dump() never raises).
     ``on_stall`` — optional callback for tests/custom handling."""
 
     def __init__(self, timeout: float, sink=None,
                  on_stall: Optional[Callable[[], None]] = None,
-                 interval: Optional[float] = None, stream=None):
+                 interval: Optional[float] = None, stream=None,
+                 flight=None, flight_path: Optional[str] = None):
         if timeout <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout}")
         self.timeout = timeout
@@ -83,6 +87,8 @@ class Watchdog:
         self._sink = sink
         self._on_stall = on_stall
         self._stream = stream
+        self._flight = flight
+        self._flight_path = flight_path
         self._interval = interval if interval is not None else max(
             0.1, timeout / 4.0)
         self._last = time.monotonic()
@@ -111,6 +117,18 @@ class Watchdog:
                     self._sink.flush()
             except Exception:
                 pass
+            if self._flight is not None and self._flight_path:
+                # dump() is internally guarded, but keep the belt:
+                # nothing on this thread may throw past the rearm
+                try:
+                    p = self._flight.dump(
+                        self._flight_path,
+                        reason=f"watchdog stall >{self.timeout}s")
+                    if p:
+                        print(f"[watchdog] flight recorder dumped to {p}",
+                              file=stream, flush=True)
+                except Exception:
+                    pass
             if self._on_stall is not None:
                 try:
                     self._on_stall()

@@ -14,6 +14,18 @@ Three layers, one schema (``registry``):
   bench/telemetry run against a recorded baseline
   (``python -m dgc_tpu.telemetry.regress BENCH_r05.json runs/new.jsonl``).
 
+Plus the tracing/postmortem layer (same sink, own schemas):
+
+* :mod:`dgc_tpu.telemetry.trace` — host-side span tracer (Chrome-trace/
+  Perfetto export through the sink) + device-side ``dgcph.*`` named-scope
+  phase markers, Python-static when off.
+* :mod:`dgc_tpu.telemetry.attrib` — device-profile parsing: XLA ops →
+  DGC phases/buckets via the markers; emits the per-bucket ``profile.json``
+  cost table the exchange planner consumes.
+* :mod:`dgc_tpu.telemetry.flight` — crash flight recorder: ring buffer of
+  recent step records, dumped atomically on stall/preemption/nonfinite
+  streak.
+
 See docs/TELEMETRY.md.
 """
 
@@ -27,10 +39,14 @@ from dgc_tpu.telemetry.registry import (
     step_out_specs,
     step_stat_names,
 )
-from dgc_tpu.telemetry.sink import TelemetrySink, read_run, summarize
+from dgc_tpu.telemetry.flight import FlightRecorder, NonfiniteStreak
+from dgc_tpu.telemetry.sink import (SchemaMismatchError, TelemetrySink,
+                                    read_run, summarize)
+from dgc_tpu.telemetry.trace import NULL_TRACER, SpanTracer
 
 __all__ = [
     "MetricSpec", "SCHEMA", "SCHEMA_VERSION", "STEP_METRICS", "RUN_METRICS",
     "make_header", "step_stat_names", "step_out_specs",
-    "TelemetrySink", "read_run", "summarize",
+    "TelemetrySink", "SchemaMismatchError", "read_run", "summarize",
+    "SpanTracer", "NULL_TRACER", "FlightRecorder", "NonfiniteStreak",
 ]

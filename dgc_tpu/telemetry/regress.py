@@ -17,7 +17,17 @@ Only the metrics present on BOTH sides are compared, each by its declared
 direction in :data:`dgc_tpu.telemetry.registry.RUN_METRICS` ("lower" is
 better for all of them today). A metric regresses when the new value is
 worse than baseline by more than ``tol`` (relative). Improvements always
-pass. Exit codes: 0 ok, 1 regression, 2 usage/parse error.
+pass.
+
+Exit codes (distinct so CI can tell "perf regressed" from "gate is
+misconfigured"):
+
+* 0 — pass
+* 1 — regression beyond tolerance
+* 2 — parse error / no overlapping metrics
+* 3 — baseline or run file missing (record one first — see message)
+* 4 — telemetry schema version mismatch (re-record with this tree, or
+  compare with a matching reader)
 """
 
 import json
@@ -25,6 +35,7 @@ import sys
 from typing import Dict, List, Optional
 
 from dgc_tpu.telemetry import registry, sink
+from dgc_tpu.telemetry.sink import SchemaMismatchError
 
 __all__ = ["load_summary", "compare", "main"]
 
@@ -55,6 +66,10 @@ def load_summary(path: str) -> Dict[str, float]:
     """
     try:
         header, records = sink.read_run(path)
+    except SchemaMismatchError:
+        # IS a sink file, written by a different tree — reparsing it as
+        # bench JSON would silently compare garbage; surface instead
+        raise
     except ValueError:
         with open(path) as fh:
             text = fh.read().strip()
@@ -138,6 +153,19 @@ def main(argv=None) -> int:
     try:
         base = load_summary(args.baseline)
         new = load_summary(args.run)
+    except (FileNotFoundError, IsADirectoryError) as e:
+        print(f"regress: {e}", file=sys.stderr)
+        print("regress: no baseline/run to compare — record one first:\n"
+              "  bench:     python bench.py ... > BENCH_rNN.json\n"
+              "  telemetry: python scripts/bench_model.py --arms dgc "
+              "--telemetry-out runs/base.jsonl", file=sys.stderr)
+        return 3
+    except SchemaMismatchError as e:
+        print(f"regress: {e}", file=sys.stderr)
+        print("regress: the file was written by a different telemetry "
+              "schema version — re-record it with this tree, or run the "
+              "gate from the tree that wrote it", file=sys.stderr)
+        return 4
     except (OSError, ValueError) as e:
         print(f"regress: {e}", file=sys.stderr)
         return 2

@@ -35,9 +35,18 @@ import numpy as np
 
 from dgc_tpu.telemetry import registry
 
-__all__ = ["TelemetrySink", "read_run", "summarize", "to_csv"]
+__all__ = ["TelemetrySink", "SchemaMismatchError", "read_run", "summarize",
+           "to_csv"]
 
 _CLOSE = object()
+
+
+class SchemaMismatchError(ValueError):
+    """A sink file whose schema VERSION this reader doesn't support —
+    distinct from "not a sink file at all" (plain ValueError) so callers
+    like regress can fall back on the latter but must surface the
+    former (silently re-parsing a future-versioned file as bench JSON
+    would compare garbage)."""
 
 
 def _jsonable(v: Any) -> Any:
@@ -183,8 +192,9 @@ def read_run(path: str) -> Tuple[Dict, List[Dict]]:
         raise ValueError(f"{path}: not a {registry.SCHEMA} file "
                          f"(schema={header.get('schema')!r})")
     if header.get("version") != registry.SCHEMA_VERSION:
-        raise ValueError(f"{path}: schema version {header.get('version')} "
-                         f"(reader supports {registry.SCHEMA_VERSION})")
+        raise SchemaMismatchError(
+            f"{path}: schema version {header.get('version')} "
+            f"(reader supports {registry.SCHEMA_VERSION})")
     return header, records
 
 

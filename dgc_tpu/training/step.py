@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dgc_tpu.ops import kernels
 from dgc_tpu.optim.distributed import DistributedOptimizer
 from dgc_tpu.resilience import faults as _faults
+from dgc_tpu.telemetry import trace as _trace
 from dgc_tpu.training.state import TrainState, state_specs, with_leading_axis
 from dgc_tpu.utils.compat import shard_map
 
@@ -318,10 +319,11 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
         stats0, memory0 = packed_stats, memory
         zeros = jax.tree.map(jnp.zeros_like, state.params)
-        (grads, packed_stats, loss, _), _ = jax.lax.scan(
-            micro, (zeros, packed_stats, jnp.zeros((), jnp.float32),
-                    jnp.zeros((), jnp.int32)),
-            (mb_images, mb_labels))
+        with _trace.phase("fwd_bwd"):
+            (grads, packed_stats, loss, _), _ = jax.lax.scan(
+                micro, (zeros, packed_stats, jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.int32)),
+                (mb_images, mb_labels))
         if _faults.armed():
             # deterministic NaN injection at the armed step (tests only;
             # identity — zero ops — when DGC_FAULTS is unset)
@@ -329,19 +331,22 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
         opt_state0 = (_squeeze0(state.opt_state) if per_worker_opt
                       else state.opt_state)
-        new_params, opt_state, memory, tstats, health = do_update(
-            grads, state.params, opt_state0, memory, sparsify_key)
+        with _trace.phase("update"):
+            new_params, opt_state, memory, tstats, health = do_update(
+                grads, state.params, opt_state0, memory, sparsify_key)
 
         if guards is not None:
             # the per-worker badness flag rides the loss all-reduce as a
             # stacked [2] vector — same collective count as unguarded,
             # and every worker computes the identical verdict
-            bad_local = _guard.nonfinite_flag(grads, loss)
-            packed = jax.lax.psum(jnp.stack([loss, bad_local]), axes)
-            mean_loss = packed[0] / world
+            with _trace.phase("loss"):
+                bad_local = _guard.nonfinite_flag(grads, loss)
+                packed = jax.lax.psum(jnp.stack([loss, bad_local]), axes)
+                mean_loss = packed[0] / world
             bad_count = packed[1]
         else:
-            mean_loss = jax.lax.psum(loss, axes) / world
+            with _trace.phase("loss"):
+                mean_loss = jax.lax.psum(loss, axes) / world
         metrics = {"loss": mean_loss}
         if telemetry:
             # per-worker stats -> replicated (mesh mean), matching the

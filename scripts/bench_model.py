@@ -66,6 +66,18 @@ def main():
                     help="write a telemetry JSONL run summary (sink "
                          "schema) for the regression gate: python -m "
                          "dgc_tpu.telemetry.regress BASELINE <path>")
+    ap.add_argument("--trace-ab", action="store_true",
+                    help="after the paired timing, device-profile both "
+                         "arms with dgcph.* phase markers on and write "
+                         "the per-bucket per-phase cost table "
+                         "(--profile-out) — the exchange planner's input; "
+                         "the profiled dgc-minus-dense delta reconciles "
+                         "against the paired-timing overhead "
+                         "(docs/TELEMETRY.md §Phase attribution)")
+    ap.add_argument("--profile-out", default="runs/profile.json",
+                    help="profile.json path for --trace-ab")
+    ap.add_argument("--profile-dir", default="/tmp/dgc_trace_ab",
+                    help="profiler logdir for --trace-ab")
     ap.add_argument("--mode", default="scan", choices=["scan", "dispatch"],
                     help="scan: K steps in one lax.scan dispatch (the "
                          "conservative default — its while-loop carry "
@@ -179,6 +191,54 @@ def main():
     print(f"OVERHEAD ({label[0]} - {label[1]}) median {med:.3f} ms  "
           f"IQR [{q1:.3f}, {q3:.3f}]  "
           f"({100 * med / b_ms:.1f}% of {label[1]} step)")
+
+    if args.trace_ab:
+        from dgc_tpu.telemetry import attrib
+        from dgc_tpu.telemetry import trace as dgc_trace
+        _ssum = jax.jit(lambda x: jnp.sum(x))
+        events = {}
+        prev = dgc_trace.enable(True)
+        try:
+            # fresh builds: the markers must be live at trace time (the
+            # timing arms above compiled with markers off — the honest
+            # paired numbers carry zero annotation cost)
+            profiled = {
+                "dgc": mk_dgc_dist(),
+                "dense": DistributedOptimizer(
+                    sgd(0.1, momentum=0.9, weight_decay=1e-4),
+                    Compression.none(), world_size=W),
+            }
+            for name, dist in profiled.items():
+                (loop, state), _ = prepare(dist)
+                state, _ = loop(state, jax.random.PRNGKey(0))  # warm
+                float(_ssum(state.params))
+                logdir = os.path.join(args.profile_dir, name)
+                os.makedirs(logdir, exist_ok=True)
+                with jax.profiler.trace(logdir):
+                    state, _ = loop(state, jax.random.PRNGKey(1))
+                    float(_ssum(state.params))
+                events[name] = attrib.device_events(
+                    attrib.load_trace_events(logdir))
+        finally:
+            dgc_trace.enable(prev)
+        if not events["dgc"]:
+            print("[trace-ab] no device-op events (CPU-only backends "
+                  "carry no op metadata — profile on TPU/GPU); writing "
+                  "the profile with empty tables", file=sys.stderr)
+        dgc_table = attrib.phase_table(events["dgc"], steps=args.k)
+        dense_table = attrib.phase_table(events["dense"], steps=args.k)
+        prof = attrib.profile_json(
+            dgc_table, dense_table,
+            static={"model": args.model, "bs": args.bs, "k": args.k,
+                    "ratio": args.ratio, "world": W, "mode": args.mode,
+                    "wire_bytes": setup.engine.wire_bytes_per_worker(),
+                    "payload_elems": setup.engine.payload_size},
+            measured_overhead_ms=med)
+        path = attrib.write_profile(prof, args.profile_out)
+        print(f"profile -> {path}", file=sys.stderr)
+        print(f"PROFILE delta {prof['delta_ms']:.3f} ms  "
+              f"exchange phases {prof['exchange_phase_ms']:.3f} ms  "
+              f"vs measured overhead {med:.3f} ms")
 
     if args.telemetry_out:
         from dgc_tpu.telemetry.sink import TelemetrySink

@@ -15,6 +15,10 @@ this backend — compare stages to each other, not to the paired full-step
 difference (the honest end-to-end number).
 
 Usage: python scripts/bench_stages.py [--model resnet50|resnet20] [--k 30]
+       add --attrib to ALSO take a device profile of the full exchange
+       with dgcph.* phase markers on and print the per-phase/per-bucket
+       attribution (dgc_tpu.telemetry.attrib) — the profile view is free
+       of the micro-bench floor bias above
 """
 
 import argparse
@@ -72,6 +76,11 @@ def main():
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--k", type=int, default=30)
     ap.add_argument("--ratio", type=float, default=0.001)
+    ap.add_argument("--attrib", action="store_true",
+                    help="device-profile the full exchange with phase "
+                         "markers and print the attrib table")
+    ap.add_argument("--out", default="/tmp/dgc_stages",
+                    help="profiler logdir for --attrib")
     args = ap.parse_args()
 
     from dgc_tpu import DGCCompressor, DGCSGDMemory
@@ -160,8 +169,44 @@ def main():
     # (round-1 carried hand-inlined sub-stage benches here; they
     # re-implemented engine internals and went stale the moment the engine
     # changed — per-stage attribution now comes from the device PROFILE
-    # (jax.profiler trace + XLA-op aggregation), which always measures the
-    # shipped code. The stages below call engine code directly.)
+    # via --attrib below (dgc_tpu.telemetry.attrib over a marker-annotated
+    # trace), which always measures the shipped code. The remaining
+    # stages call engine code directly.)
+
+    if args.attrib:
+        from dgc_tpu.telemetry import attrib
+        from dgc_tpu.telemetry import trace as dgc_trace
+        prev = dgc_trace.enable(True)
+        try:
+            # fresh jit so the marker-annotated program builds (the scans
+            # above traced with markers off)
+            loop = jax.jit(lambda c: jax.lax.scan(
+                lambda cc, _: (full(cc), 0), c, None, length=args.k)[0])
+            c = loop((g, mem))                      # compile + warm
+            float(_ssum(jax.tree.leaves(c)[0]))
+            os.makedirs(args.out, exist_ok=True)
+            with jax.profiler.trace(args.out):
+                c = loop(c)
+                float(_ssum(jax.tree.leaves(c)[0]))
+        finally:
+            dgc_trace.enable(prev)
+        events = attrib.device_events(attrib.load_trace_events(args.out))
+        if not events:
+            print("[attrib] no device-op events in the trace (CPU-only "
+                  "backends carry no op metadata — run on TPU/GPU)",
+                  file=sys.stderr)
+        else:
+            table = attrib.phase_table(events, steps=args.k)
+            print(f"--- profile attribution: {table['attributed_ms']:.3f} "
+                  f"of {table['total_ms']:.3f} ms/iter attributed ---",
+                  file=sys.stderr)
+            for ph, ms in table["phases"].items():
+                print(f"  {ms:8.4f}  {ph}", file=sys.stderr)
+            for b, phases in table["buckets"].items():
+                tot = sum(phases.values())
+                print(f"  {tot:8.4f}  {b}  " + "  ".join(
+                    f"{p}={v:.4f}" for p, v in phases.items()),
+                    file=sys.stderr)
 
     # --- masking + scatter-add decompress ---
     vals0, idx0 = jax.jit(lambda v, k: engine.sparsify(v, k))(gc, key)

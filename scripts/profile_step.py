@@ -1,20 +1,21 @@
 """Device-profile decomposition of the DGC vs dense train step.
 
-Traces K steps of each config with jax.profiler, parses the xplane proto
-(tensorboard_plugin_profile), aggregates per-op device durations, and
-prints the top ops per config plus a diff view — the attribution tool
-behind docs/RESULTS.md's overhead decomposition. Isolated micro-benches on
-this backend are floor-dominated and DCE-prone (see bench.py); the profile
-measures the shipped program.
+Traces K steps of each config with jax.profiler and aggregates per-op
+device durations through :mod:`dgc_tpu.telemetry.attrib` (the one trace
+parser — this script used to carry its own copy), printing the top ops
+per config plus a diff view — the attribution tool behind
+docs/RESULTS.md's overhead decomposition. Isolated micro-benches on this
+backend are floor-dominated and DCE-prone (see bench.py); the profile
+measures the shipped program. Run with ``--trace`` on the train side (or
+``scripts/bench_model.py --trace-ab``) for the per-phase/per-bucket view
+on top of the per-source one.
 
 Usage: python scripts/profile_step.py [--model resnet50] [--bs 32] [--k 10]
 """
 
 import argparse
-import glob
 import os
 import sys
-from collections import defaultdict
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -22,57 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def parse_trace(logdir, repo_root):
-    """Aggregate LEAF device ops of the newest Chrome-trace JSON under
-    logdir. Returns (by_source, by_name, leaf_total_ms): by_source groups
-    ops by their `source` file:line attribution (repo paths shortened),
-    by_name keeps individual op names with sample metadata. Envelope
-    events (jit_* / while.* wrappers) are excluded from totals."""
-    import gzip
-    import json as jsonlib
-    paths = sorted(glob.glob(os.path.join(
-        logdir, "plugins/profile/*/*.trace.json.gz")), key=os.path.getmtime)
-    assert paths, f"no trace.json.gz under {logdir}"
-    with gzip.open(paths[-1], "rt") as f:
-        trace = jsonlib.load(f)
-    events = trace.get("traceEvents", [])
-    pid_name = {}
-    for ev in events:
-        if ev.get("ph") == "M" and ev.get("name") == "process_name":
-            pid_name[ev.get("pid")] = ev.get("args", {}).get("name", "")
-    by_source = defaultdict(float)
-    by_name = defaultdict(lambda: [0.0, None])
-    leaf_total = 0.0
-    for ev in events:
-        if ev.get("ph") != "X" or "dur" not in ev:
-            continue
-        pname = pid_name.get(ev.get("pid"), "").lower()
-        if "tpu" not in pname or "host" in pname:
-            continue
-        name = ev["name"]
-        if name.startswith(("jit_", "while", "Overhead", "idle")):
-            continue  # envelopes / non-op lanes
-        args = ev.get("args", {}) or {}
-        if "hlo_category" not in args:
-            continue  # step-number / module lanes double-count the ops
-        ms = ev["dur"] / 1e3
-        src = args.get("source", "")
-        src = src.replace(repo_root + "/", "").replace(
-            "scripts/../", "")
-        cat = args.get("hlo_category", "?")
-        if "site-packages" in src or not src:
-            tfop = args.get("tf_op", "")
-            key = ("model" if "ResNet" in tfop or "transpose" in tfop
-                   or "conv" in tfop else f"lib:{cat}")
-        else:
-            key = f"{src} [{cat}]"
-        by_source[key] += ms
-        by_name[name][0] += ms
-        if by_name[name][1] is None:
-            by_name[name][1] = (src, cat, args.get("tf_op", "")[-80:])
-        leaf_total += ms
-    return dict(by_source), dict(by_name), leaf_total
+from dgc_tpu.telemetry import attrib
+from dgc_tpu.telemetry import trace as dgc_trace
 
 
 def main():
@@ -85,7 +37,13 @@ def main():
     ap.add_argument("--out", default="/tmp/dgc_profile")
     ap.add_argument("--mem-dtype", default=None,
                     help="error-feedback state dtype for the dgc arm")
+    ap.add_argument("--phases", action="store_true",
+                    help="enable dgcph.* markers and print the per-phase "
+                         "attribution table alongside the per-source one")
     args = ap.parse_args()
+
+    if args.phases:
+        dgc_trace.enable(True)
 
     import bench
     from dgc_tpu import (Compression, DGCCompressor, DGCSGDMemory,
@@ -142,13 +100,22 @@ def main():
         with jax.profiler.trace(logdir):
             state, _ = k_loop(state, jax.random.PRNGKey(1))
             float(_ssum(state.params))
-        by_source, by_name, leaf_total = parse_trace(logdir, repo_root)
+        events = attrib.device_events(attrib.load_trace_events(logdir),
+                                      device="tpu")
+        by_source, by_name, leaf_total = attrib.aggregate_by_source(
+            events, repo_root)
         per_config[name] = by_source
         print(f"\n=== {name}: leaf device total {leaf_total / args.k:.3f} "
               f"ms/step ===")
         for nm, (ms, meta) in sorted(by_name.items(),
                                      key=lambda kv: -kv[1][0])[:args.top]:
             print(f"  {ms / args.k:8.4f}  {nm:<36s} {meta}")
+        if args.phases:
+            table = attrib.phase_table(events, steps=args.k)
+            print(f"  --- phases ({table['attributed_ms']:.3f} of "
+                  f"{table['total_ms']:.3f} ms/step attributed) ---")
+            for ph, ms in table["phases"].items():
+                print(f"  {ms:8.4f}  {ph}")
 
     d, b = per_config["dgc"], per_config["dense"]
     print("\n=== per-source decomposition: DGC minus dense (ms/step) ===")

@@ -17,6 +17,13 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "TELEMETRY_SMOKE=ok" || { echo "TELEMETRY_SMOKE=FAIL"; rc=1; }
+# tracing smoke (docs/TELEMETRY.md §Tracing/§Flight recorder): span
+# nesting + Chrome-trace schema, attrib op->phase mapping over the
+# recorded device-trace fixture, flight-ring wraparound + atomic dump,
+# and the regress exit-code contract (3 missing / 4 schema mismatch)
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "TRACE_SMOKE=ok" || { echo "TRACE_SMOKE=FAIL"; rc=1; }
 # resilience smoke (docs/RESILIENCE.md): one guarded+checksummed train run
 # under simultaneous NaN and bit-flip injection — the nan step must skip
 # atomically, the checksum must count every corrupted exchange, and

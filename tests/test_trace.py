@@ -1,0 +1,373 @@
+"""Tests for the structured-tracing stack (docs/TELEMETRY.md §Tracing):
+
+* host spans — nesting/ordering, wrap_iter, step summaries, the
+  Chrome-trace export schema, and the sink round-trip;
+* device phase markers — phase() is a nullcontext when off, a
+  dgcph.<phase>[.b<idx>] named scope when on;
+* attrib — op→phase/bucket mapping and the per-bucket table against a
+  recorded device-format trace fixture (CPU profiler traces carry no op
+  metadata, so the fixture stands in for a TPU trace);
+* flight recorder — ring wraparound, raw-value storage, atomic dump +
+  load, the nonfinite-streak breaker;
+* regress exit codes — 3 (missing artifact) and 4 (schema mismatch)
+  stay distinct and actionable.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu.telemetry import attrib, regress
+from dgc_tpu.telemetry import trace as trace_mod
+from dgc_tpu.telemetry.flight import (
+    FlightRecorder,
+    NonfiniteStreak,
+    load_dump,
+)
+from dgc_tpu.telemetry.trace import (
+    NULL_TRACER,
+    SpanTracer,
+    chrome_trace_from_records,
+    validate_chrome_trace,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "device_trace.json")
+
+
+# --------------------------------------------------------------------- #
+# host spans                                                             #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_span_nesting_and_ordering():
+    tr = SpanTracer()
+    with tr.span("epoch", epoch=0):
+        with tr.span("step_dispatch", step=1):
+            pass
+        with tr.span("step_dispatch", step=2):
+            pass
+    evs = tr.events()
+    # completion order: inner spans close before the outer one
+    assert [e["name"] for e in evs] == ["step_dispatch", "step_dispatch",
+                                       "epoch"]
+    inner1, inner2, outer = evs
+    assert inner1["args"]["parent"] == "epoch"
+    assert inner2["args"]["parent"] == "epoch"
+    assert "parent" not in outer["args"]
+    assert inner1["args"]["step"] == 1
+    # timestamps are monotonic and the outer span covers the inner ones
+    assert inner1["ts"] <= inner2["ts"]
+    assert outer["ts"] <= inner1["ts"]
+    assert outer["ts"] + outer["dur"] >= inner2["ts"] + inner2["dur"]
+
+
+@pytest.mark.fast
+def test_span_survives_exception():
+    tr = SpanTracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("bad"):
+            raise RuntimeError("boom")
+    assert [e["name"] for e in tr.events()] == ["bad"]
+    # the per-thread stack unwound: a new span has no stale parent
+    with tr.span("after"):
+        pass
+    assert "parent" not in tr.events()[-1]["args"]
+
+
+@pytest.mark.fast
+def test_wrap_iter_spans_each_next():
+    tr = SpanTracer()
+    out = list(tr.wrap_iter(iter([1, 2, 3]), "data_load"))
+    assert out == [1, 2, 3]
+    # one span per next() including the final StopIteration probe
+    names = [e["name"] for e in tr.events()]
+    assert names == ["data_load"] * 4
+
+
+@pytest.mark.fast
+def test_step_summary_accumulates_and_resets():
+    tr = SpanTracer()
+    with tr.span("step_dispatch"):
+        pass
+    with tr.span("step_dispatch"):
+        pass
+    s = tr.step_summary()
+    assert set(s) == {"step_dispatch"} and s["step_dispatch"] >= 0
+    assert tr.step_summary() == {}          # reset drained it
+
+
+@pytest.mark.fast
+def test_chrome_trace_schema_and_save(tmp_path):
+    tr = SpanTracer()
+    with tr.span("checkpoint", epoch=3):
+        pass
+    obj = tr.chrome_trace()
+    assert validate_chrome_trace(obj) == []
+    assert obj["displayTimeUnit"] == "ms"
+    metas = [e for e in obj["traceEvents"] if e["ph"] == "M"]
+    assert any(m["name"] == "process_name" for m in metas)
+    p = tr.save(str(tmp_path / "trace.json"))
+    assert validate_chrome_trace(json.load(open(p))) == []
+
+
+@pytest.mark.fast
+def test_validate_chrome_trace_flags_garbage():
+    assert validate_chrome_trace({}) != []
+    bad = {"traceEvents": [{"ph": "Z", "name": "x", "pid": 1, "tid": 1},
+                           {"ph": "X", "name": 7, "pid": 1, "tid": 1,
+                            "ts": -1, "dur": 1}]}
+    msgs = validate_chrome_trace(bad)
+    assert any("bad ph" in m for m in msgs)
+    assert any("ts" in m for m in msgs)
+
+
+@pytest.mark.fast
+def test_sink_roundtrip_rebuilds_chrome_trace():
+    records = [
+        {"event": "span", "name": "data_load", "ts_us": 10.0,
+         "dur_us": 5.0, "tid": 7},
+        {"event": "step", "step": 1},                    # non-span: skipped
+        {"event": "span", "name": "step_dispatch", "ts_us": 20.0,
+         "dur_us": 3.0, "tid": 7, "step": 1, "parent": "epoch"},
+    ]
+    obj = chrome_trace_from_records(records)
+    assert validate_chrome_trace(obj) == []
+    xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["data_load", "step_dispatch"]
+    assert xs[1]["args"] == {"step": 1, "parent": "epoch"}
+
+
+@pytest.mark.fast
+def test_null_tracer_is_inert(tmp_path):
+    with NULL_TRACER.span("x"):
+        pass
+    assert list(NULL_TRACER.wrap_iter([1], "y")) == [1]
+    assert NULL_TRACER.step_summary() == {}
+    assert NULL_TRACER.save(str(tmp_path / "t.json")) is None
+
+
+# --------------------------------------------------------------------- #
+# device phase markers                                                   #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_phase_off_is_nullcontext():
+    prev = trace_mod.enable(False)
+    try:
+        import contextlib
+        assert isinstance(trace_mod.phase("select", 3),
+                          contextlib.nullcontext)
+    finally:
+        trace_mod.enable(prev)
+
+
+@pytest.mark.fast
+def test_scope_names():
+    assert trace_mod.scope_name("pack") == "dgcph.pack"
+    assert trace_mod.scope_name("select", 4) == "dgcph.select.b4"
+
+
+def test_markers_land_in_compiled_text_only_when_on():
+    # a FRESH function per build: jax's jaxpr cache keys on the function
+    # object, not the trace flag, so reusing one across enable() flips
+    # would leak the first build's markers (the same hazard that keeps
+    # module-level jitted kernels undecorated — see ops/kernels.py)
+    def make():
+        def f(x):
+            with trace_mod.phase("select", 2):
+                return jnp.sum(x * 2.0)
+        return f
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    prev = trace_mod.enable(True)
+    try:
+        on = jax.jit(make()).lower(x).compile().as_text()
+    finally:
+        trace_mod.enable(prev)
+    trace_mod.enable(False)
+    off_l = jax.jit(make()).lower(x)
+    off = off_l.compile().as_text()
+    assert "dgcph.select.b2" in on
+    assert "dgcph" not in off
+    # and the off build's LOWERED text carries no trace of the marker
+    assert "dgcph" not in off_l.as_text()
+
+
+# --------------------------------------------------------------------- #
+# attrib: op -> phase mapping over the recorded fixture                  #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_op_phase_mapping():
+    ev = {"args": {"tf_op": "jit(s)/dgcph.select.b2/sort"}}
+    assert attrib.op_phase(ev) == ("select", 2)
+    ev = {"args": {"tf_op": "jit(s)/dgcph.pack/concat"}}
+    assert attrib.op_phase(ev) == ("pack", None)
+    # innermost token wins when scopes nest
+    ev = {"args": {"tf_op": "jit(s)/dgcph.compensate/dgcph.pack/bitcast"}}
+    assert attrib.op_phase(ev) == ("pack", None)
+    assert attrib.op_phase({"args": {"tf_op": "jit(s)/mul"}}) == (None, None)
+    assert attrib.op_phase({}) == (None, None)
+
+
+@pytest.mark.fast
+def test_device_events_filters_fixture():
+    events = attrib.load_trace_events(FIXTURE)
+    dev = attrib.device_events(events)
+    names = sorted(e["name"] for e in dev)
+    # envelope (jit_train_step), no-category (step 42) and host-pid
+    # events are all excluded; the 9 leaf device ops remain
+    assert len(dev) == 9
+    assert "jit_train_step" not in names and "step 42" not in names
+
+
+@pytest.mark.fast
+def test_phase_table_against_fixture():
+    dev = attrib.device_events(attrib.load_trace_events(FIXTURE))
+    t = attrib.phase_table(dev, steps=1)
+    # durations are µs in the fixture -> ms here
+    assert t["total_ms"] == pytest.approx(2.39)
+    assert t["unattributed_ms"] == pytest.approx(0.5)    # copy.2
+    assert t["phases"]["threshold"] == pytest.approx(0.1)
+    assert t["phases"]["select"] == pytest.approx(0.2)
+    assert t["phases"]["pack"] == pytest.approx(0.09)    # incl. nested win
+    assert t["phases"]["allgather"] == pytest.approx(0.3)
+    assert t["phases"]["decode"] == pytest.approx(0.08)
+    assert t["phases"]["apply"] == pytest.approx(0.12)
+    assert t["phases"]["fwd_bwd"] == pytest.approx(1.0)
+    # bucket split: b0 carries threshold+select, b1 decode
+    assert t["buckets"]["b0"]["threshold"] == pytest.approx(0.1)
+    assert t["buckets"]["b0"]["select"] == pytest.approx(0.2)
+    assert t["buckets"]["b1"]["decode"] == pytest.approx(0.08)
+    # phase keys come out in canonical pipeline order
+    order = [p for p in trace_mod.PHASES if p in t["phases"]]
+    assert list(t["phases"]) == order
+
+
+@pytest.mark.fast
+def test_profile_json_roundtrip(tmp_path):
+    dev = attrib.device_events(attrib.load_trace_events(FIXTURE))
+    t = attrib.phase_table(dev, steps=2)
+    dense = attrib.phase_table([], steps=2)
+    prof = attrib.profile_json(t, dense, static={"world": 8},
+                               measured_overhead_ms=0.106)
+    assert prof["delta_ms"] == pytest.approx(t["total_ms"])
+    # exchange phases exclude fwd_bwd/update/loss
+    assert prof["exchange_phase_ms"] == pytest.approx(
+        sum(v for p, v in t["phases"].items() if p != "fwd_bwd"))
+    p = attrib.write_profile(prof, str(tmp_path / "profile.json"))
+    assert attrib.load_profile(p)["measured_overhead_ms"] == 0.106
+    with pytest.raises(ValueError):
+        attrib.load_profile(FIXTURE)       # wrong schema
+
+
+@pytest.mark.fast
+def test_trace_cli_rebuilds_from_sink_jsonl(tmp_path, capsys):
+    from dgc_tpu.telemetry.registry import SCHEMA, SCHEMA_VERSION
+    run = tmp_path / "telemetry.jsonl"
+    lines = [{"schema": SCHEMA, "version": SCHEMA_VERSION, "static": {}},
+             {"event": "span", "name": "eval", "ts_us": 1.0, "dur_us": 2.0,
+              "tid": 1}]
+    run.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+    out = tmp_path / "trace.json"
+    assert trace_mod._main([str(run), "-o", str(out)]) == 0
+    obj = json.load(open(out))
+    assert validate_chrome_trace(obj) == []
+    assert sum(1 for e in obj["traceEvents"] if e["ph"] == "X") == 1
+
+
+# --------------------------------------------------------------------- #
+# flight recorder                                                        #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_flight_ring_wraparound():
+    fr = FlightRecorder(capacity=3)
+    for s in range(5):
+        fr.record(s, loss=float(s))
+    assert len(fr) == 3
+    assert [r["step"] for r in fr.records()] == [2, 3, 4]
+
+
+@pytest.mark.fast
+def test_flight_dump_atomic_and_loadable(tmp_path):
+    fr = FlightRecorder(capacity=4, static={"world": 8})
+    # raw device arrays + a nonfinite + an unconvertible value
+    fr.record(1, loss=jnp.float32(1.5), spans_ms={"step_dispatch": 2.0})
+    fr.record(2, loss=float("nan"), weird=object())
+    p = fr.dump(str(tmp_path / "flight.json"), reason="test",
+                extra={"note": "x"})
+    assert p is not None
+    assert os.listdir(tmp_path) == ["flight.json"]     # tmp file renamed
+    obj = load_dump(p)
+    assert obj["reason"] == "test" and obj["static"] == {"world": 8}
+    assert obj["recorded"] == 2 and obj["capacity"] == 4
+    r1, r2 = obj["records"]
+    assert r1["loss"] == 1.5
+    assert r1["spans_ms"] == {"step_dispatch": 2.0}
+    assert r2["loss"] == "nan"                          # guarded repr
+    assert r2["weird"].startswith("<unconvertible:")
+    # dump never raises, even to an unwritable path
+    assert fr.dump("/proc/nope/flight.json") is None
+
+
+@pytest.mark.fast
+def test_flight_dump_truncates_arrays(tmp_path):
+    fr = FlightRecorder()
+    fr.record(1, grad=np.arange(1000, dtype=np.float32))
+    obj = load_dump(fr.dump(str(tmp_path / "f.json")))
+    assert len(obj["records"][0]["grad"]) == 64
+
+
+@pytest.mark.fast
+def test_nonfinite_streak_breaker():
+    ns = NonfiniteStreak(threshold=3)
+    assert not ns.update(float("nan"))
+    assert not ns.update(float("inf"))
+    assert not ns.update(1.0)                 # finite resets
+    assert ns.streak == 0
+    assert not ns.update(float("nan"))
+    assert not ns.update(float("nan"))
+    assert ns.update(float("nan"))            # third consecutive trips
+    assert ns.update(0.0)                     # tripped stays tripped
+
+
+@pytest.mark.fast
+def test_flight_load_rejects_foreign_schema(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"schema": "other", "version": 1}))
+    with pytest.raises(ValueError):
+        load_dump(str(p))
+
+
+# --------------------------------------------------------------------- #
+# regress exit codes                                                     #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_regress_exit_3_on_missing_artifact(tmp_path, capsys):
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps({"metric": "x", "value": 1.0}))
+    rc = regress.main([str(tmp_path / "nope.json"), str(run)])
+    assert rc == 3
+    err = capsys.readouterr().err
+    assert "record one first" in err
+
+
+@pytest.mark.fast
+def test_regress_exit_4_on_schema_mismatch(tmp_path, capsys):
+    from dgc_tpu.telemetry.registry import SCHEMA
+    base = tmp_path / "base.jsonl"
+    base.write_text(json.dumps(
+        {"schema": SCHEMA, "version": 999, "static": {}}) + "\n")
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps({"metric": "x", "value": 1.0}))
+    rc = regress.main([str(base), str(run)])
+    assert rc == 4
+    err = capsys.readouterr().err
+    assert "schema version" in err and "re-record" in err

@@ -65,16 +65,22 @@ def _narrow_model_dtype(model):
     return None
 
 
-def drain_loss_log(writer, loss_log):
+def drain_loss_log(writer, loss_log, on_loss=None):
     """Convert the epoch's collected device losses in one go.
 
     The train loop appends ``(num_inputs, device_scalar)`` pairs instead
     of calling ``float()`` per logged step — a per-step conversion blocks
     the dispatch pipeline behind every enqueued step. Draining here costs
-    one host sync per epoch, after all steps are in flight."""
+    one host sync per epoch, after all steps are in flight.
+
+    ``on_loss`` sees each converted value in order (the nonfinite-streak
+    breaker taps in here: the drain is the only place losses become
+    host floats without adding a sync)."""
     loss = 0.0
     for at, dev_loss in loss_log:
         loss = float(dev_loss)
+        if on_loss is not None:
+            on_loss(loss)
         writer.add_scalar("loss/train", loss, at)
     loss_log.clear()
     return loss
@@ -91,6 +97,12 @@ def main():
     parser.add_argument("--profile", action="store_true",
                         help="write a device trace of the first training "
                              "steps to <save_path>/profile")
+    parser.add_argument("--trace", action="store_true",
+                        help="structured tracing: host-side spans + "
+                             "device-side dgcph.* phase markers, saved as "
+                             "a Perfetto-loadable <save_path>/trace.json "
+                             "(docs/TELEMETRY.md §Tracing); same as "
+                             "stacking configs/trace.py")
     parser.add_argument("--elastic", action="store_true",
                         help="allow resuming under a different world size: "
                              "reshard the per-worker DGC state "
@@ -433,18 +445,55 @@ def main():
             sink.write_record(dict(elastic_pending,
                                    event="elastic_restart"))
 
+    # structured tracing (configs/trace.py or --trace, docs/TELEMETRY.md
+    # §Tracing): device-side dgcph.* phase markers must be enabled BEFORE
+    # the step builds below (they bake into the program at trace time);
+    # host-side spans stream through the telemetry sink and are saved as
+    # a Chrome trace at the end of the run
+    from dgc_tpu.telemetry import trace as _trace
+    trccfg = configs.train.get("trace", None)
+    trace_on = bool(args.trace or (trccfg and trccfg.get("enabled", False)))
+    tracer = _trace.NULL_TRACER
+    if trace_on:
+        _trace.enable(True)
+        tracer = _trace.SpanTracer(
+            sink=sink,
+            max_events=int(trccfg.get("max_events", 65536)) if trccfg
+            else 65536)
+        printr("[trace] device phase markers on; host spans -> "
+               + os.path.join(configs.train.save_path, "trace.json"))
+
     # host-side resilience: signal -> flag (the loop does the emergency
-    # save at a step boundary); watchdog dumps stacks on a stalled step
+    # save at a step boundary); watchdog dumps stacks on a stalled step;
+    # the flight recorder keeps a ring of recent step records for the
+    # postmortem dump (watchdog stall / preemption / nonfinite streak)
     handler = watchdog = None
+    flight = flight_path = streak = None
     if res_on:
         from dgc_tpu.resilience import faults as _faults
         from dgc_tpu.resilience import preempt as _preempt
         handler = _preempt.PreemptionHandler()
+        fl_steps = int(rcfg.get("flight_steps", 0) or 0)
+        if fl_steps > 0:
+            from dgc_tpu.telemetry.flight import FlightRecorder
+            flight = FlightRecorder(
+                capacity=fl_steps,
+                static=dict(flat_setup.engine.telemetry_static(),
+                            world=world, num_local_workers=num_local,
+                            save_path=configs.train.save_path))
+            flight_path = os.path.join(configs.train.save_path,
+                                       "flight.json")
+        ns = int(rcfg.get("nonfinite_streak", 0) or 0)
+        if ns > 0:
+            from dgc_tpu.telemetry.flight import NonfiniteStreak
+            streak = NonfiniteStreak(ns)
         wd_secs = float(rcfg.get("watchdog_secs", 0) or 0)
         if wd_secs > 0:
-            watchdog = _preempt.Watchdog(wd_secs, sink=sink)
+            watchdog = _preempt.Watchdog(wd_secs, sink=sink, flight=flight,
+                                         flight_path=flight_path)
         printr(f"[resilience] guards={guards_cfg} checksum={res_checksum} "
-               f"watchdog={wd_secs or 'off'}")
+               f"watchdog={wd_secs or 'off'} "
+               f"flight={fl_steps or 'off'}")
 
     ############
     # Training #
@@ -458,6 +507,8 @@ def main():
     gstep = (last_epoch + 1) * steps_per_epoch + resume_batch
     preempted = False
     preempt_at = -1
+    aborted = False          # nonfinite-streak breaker tripped
+    last_ckpt_epoch = last_epoch
     for epoch in range(last_epoch + 1, configs.train.num_epochs):
         printr(f"\n==> training epoch {epoch}/{configs.train.num_epochs}")
 
@@ -514,6 +565,10 @@ def main():
                 batches,
                 lambda b: (host_local_to_global(b[0], mesh),
                            host_local_to_global(b[1], mesh)))
+            # span each next(): time the loop spends WAITING on batch
+            # prep + host->device staging (a hot data_load lane in the
+            # trace means the input pipeline is the bottleneck)
+            staged = tracer.wrap_iter(staged, "data_load")
             for rel_idx, (images, labels) in enumerate(staged):
                 bidx = bofs + rel_idx
                 # preemption check at the step boundary: agree_preempt is
@@ -524,9 +579,14 @@ def main():
                         handler.requested):
                     preempted, preempt_at = True, bidx - 1
                     break
-                state, metrics = step_fn(state, images, labels,
-                                         jax.random.fold_in(
-                                             base_key, epoch * 100003 + bidx))
+                # span covers DISPATCH only (async jax: the call returns
+                # as soon as the step is enqueued) — device-side time
+                # lives in the profiler trace, not here
+                with tracer.span("step_dispatch", step=gstep):
+                    state, metrics = step_fn(
+                        state, images, labels,
+                        jax.random.fold_in(
+                            base_key, epoch * 100003 + bidx))
                 if profile_left:
                     profile_left -= 1
                     if profile_left == 0:
@@ -535,6 +595,16 @@ def main():
                 seen += 1
                 num_inputs += global_batch
                 gstep += 1
+                if flight is not None:
+                    # raw device scalars go into the ring (zero syncs);
+                    # conversion happens only at dump time
+                    flight.record(
+                        gstep, epoch=epoch, batch=bidx,
+                        num_inputs=num_inputs,
+                        loss=metrics["loss"],
+                        guards=metrics.get("guards"),
+                        spans_ms=tracer.step_summary(),
+                        last_ckpt_epoch=last_ckpt_epoch)
                 if watchdog is not None:
                     watchdog.beat()
                 if res_on and _faults.armed():
@@ -567,11 +637,21 @@ def main():
         else:
             if not logged:
                 loss_log.append((num_inputs, metrics["loss"]))
-            loss = drain_loss_log(writer, loss_log)
+            # the drain is the epoch's one host sync: it waits for every
+            # enqueued step (exchange included) to complete — hence the
+            # span name. The streak breaker taps each converted loss.
+            with tracer.span("exchange_wait", epoch=epoch):
+                loss = drain_loss_log(
+                    writer, loss_log,
+                    on_loss=streak.update if streak is not None else None)
             printr(f"[loss] = {loss:.4f}  ({seen} steps, "
                    f"{dt / max(seen, 1) * 1000:.1f} ms/step)")
+            if streak is not None and streak.tripped:
+                aborted = True
+                break
 
-        meters = evaluate(state)
+        with tracer.span("eval", epoch=epoch):
+            meters = evaluate(state)
         best = False
         if configs.train.get("metric") is not None:
             m = meters.get(configs.train.metric)
@@ -582,8 +662,24 @@ def main():
             printr(f"[{k}] = {v:.2f}")
             writer.add_scalar(k, v, num_inputs)
 
-        path = ckpt.save(epoch, state, meters, best=best, topology=topology)
+        with tracer.span("checkpoint", epoch=epoch):
+            path = ckpt.save(epoch, state, meters, best=best,
+                             topology=topology)
+        last_ckpt_epoch = epoch
         printr(f"[save_path] = {path}")
+
+    if aborted:
+        # guards can skip individual bad steps, but a SUSTAINED nonfinite
+        # run means the training state itself is gone — stop burning the
+        # reservation and leave the flight recorder as the postmortem
+        printr(f"\n[resilience] {streak.streak} consecutive nonfinite "
+               f"losses at epoch {epoch} — aborting "
+               f"(last checkpoint: epoch {last_ckpt_epoch})")
+        if flight is not None:
+            p = flight.dump(flight_path,
+                            reason=f"nonfinite-streak x{streak.streak}")
+            if p:
+                printr(f"[resilience] flight recorder -> {p}")
 
     if preempted:
         # emergency checkpoint: full state (compressor memory included) +
@@ -592,6 +688,11 @@ def main():
         # step (agree_preempt), so the collective save lines up.
         printr(f"\n[preempt] signal {handler.signum}: stopping at "
                f"epoch {epoch}, batch {preempt_at}")
+        if flight is not None:
+            p = flight.dump(flight_path,
+                            reason=f"preempt signal {handler.signum}")
+            if p:
+                printr(f"[preempt] flight recorder -> {p}")
         if bool(rcfg.get("emergency_checkpoint", True)):
             emeters = {"preempt_batch": preempt_at}
             if best_metric is not None:
@@ -603,6 +704,12 @@ def main():
                                            topology=topology)
             printr(f"[preempt] emergency checkpoint -> {path}")
 
+    if trace_on:
+        tpath = tracer.save(
+            os.path.join(configs.train.save_path, "trace.json"))
+        if tpath:
+            printr(f"[trace] chrome trace -> {tpath}  "
+                   "(load at ui.perfetto.dev)")
     if sink is not None:
         sink.close()
     writer.close()
@@ -610,6 +717,11 @@ def main():
         watchdog.stop()
     if handler is not None:
         handler.uninstall()
+    if aborted:
+        # EX_SOFTWARE: unrecoverable training state — a supervisor must
+        # NOT blindly relaunch (resume would replay the same divergence);
+        # distinct from the preemption 75 below
+        raise SystemExit(70)
     if preempted:
         _preempt.clean_shutdown()
         # EX_TEMPFAIL: tell a supervisor (scripts/supervise.py) this was
