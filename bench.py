@@ -230,8 +230,15 @@ def main():
     fused_apply = os.environ.get("DGC_FUSED_APPLY", "") == "1"
     if fused_apply:
         print("fused apply epilogue: ON", file=sys.stderr)
+    # DGC_FUSED_SELECT=1 switches sparsify to the fused Pallas
+    # threshold->select->pack pass (kernels.select_pack_rows) for the
+    # same paired A/B against the default top_k + take_along_axis path
+    fused_select = os.environ.get("DGC_FUSED_SELECT", "") == "1"
+    if fused_select:
+        print("fused select/pack: ON", file=sys.stderr)
     comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
-                         fused_apply=fused_apply)
+                         fused_apply=fused_apply,
+                         fused_select=fused_select)
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
 
     if os.environ.get("DGC_TELEMETRY_AB", "") == "1":
@@ -395,6 +402,46 @@ def main():
           f"dense {pk_dense:.4f} ms | dgc {pk_dgc:.4f} ms | ratio "
           f"{pk_dense / pk_dgc:.2f}x", file=sys.stderr)
 
+    # --- regime-aware exchange planner (ISSUE 8): per fabric, the
+    #     planner's chosen per-bucket regimes and its predicted
+    #     planned-vs-dense ratio, plus the same realized model the rows
+    #     above use (measured overhead + modeled wire, but with the
+    #     engine's lane-exact per-bucket wire bytes under the plan).
+    #     A dense-planned bucket rides the psum (zero marginal wire
+    #     model here beyond the dense term it already pays); all-dense
+    #     plans drop the DGC overhead entirely -> ratio 1.0, never
+    #     worse than the baseline.
+    from dgc_tpu.compression.planner import BUILTIN_FABRICS, plan_engine
+    planned = {}
+    for fab_key, fab_name, gbps, workers in (
+            ("32x25GbE", "32x25GbE", FABRIC_GBPS, FABRIC_WORKERS),
+            ("ici_v5e8", "ici_v5e8", ICI_GBPS, ICI_WORKERS)):
+        plan = plan_engine(dgc_setup.engine,
+                           fabric=BUILTIN_FABRICS[fab_name], world=workers)
+        pred = plan.predicted_ms()
+        dense_ex = (2 * 4 * P_total * (workers - 1) / workers) / (
+            gbps * 1e9) * 1e3
+        if plan.all_dense:
+            realized = dense_ex
+        else:
+            eng_p = comp.make_flat_exchange(dgc_setup.layout, plan=plan)
+            wire = sum(eng_p.bucket_wire_bytes())
+            realized = dgc_overhead_ms + (
+                (workers - 1) * wire) / (gbps * 1e9) * 1e3
+        planned[fab_key] = {
+            "regimes": list(plan.regimes),
+            "predicted_planned_ms": round(pred["planned_ms"], 5),
+            "predicted_dense_ms": round(pred["dense_ms"], 5),
+            "predicted_ratio": round(pred["ratio"], 3),
+            "dense_ms": round(dense_ex, 5),
+            "dgc_ms": round(realized, 5),
+            "ratio": round(dense_ex / realized, 3),
+        }
+        print(f"[planned {fab_key}] regimes {list(plan.regimes)} | dense "
+              f"{dense_ex:.4f} ms | planned {realized:.4f} ms | ratio "
+              f"{dense_ex / realized:.2f}x (model {pred['ratio']:.2f}x)",
+              file=sys.stderr)
+
     # spread of the paired per-round overhead: the recorded artifact must
     # carry the distribution, not one session's draw
     q1, q3 = (float(x) for x in np.percentile(diffs, [25, 75]))
@@ -446,6 +493,7 @@ def main():
             "dense_ms": round(pk_dense, 5),
             "dgc_ms": round(pk_dgc, 5),
             "ratio": round(pk_dense / pk_dgc, 3)},
+        "planned": planned,
     }))
 
 

@@ -27,6 +27,11 @@ tests exercise:
   time host code — a step whose batch geometry went through
   ``resolve_batch_geometry`` (identity) is byte-identical to the plain
   build, and no ``resilience/elastic`` code ever lowers into the step.
+* **the exchange plan is the program**: for every planner regime family
+  (dense / fp32 / int8 / int8+packed-idx), ``Plan.collectives()`` equals
+  the lowered HLO's collective counts — the all-dense plan compiles the
+  sparse path away to zero gathers (the planner's never-lose fallback is
+  structural, not a runtime branch).
 * **f32 end-to-end**: no f64 tensor type in any variant.
 * **trace stability**: same-shape calls never retrace.
 * **shard_state stays collective-free** (source contract): the
@@ -51,14 +56,17 @@ DENSE_COLLECTIVES = {"all-gather": 0, "all-reduce": 2}
 
 
 def build_fixture(mesh=None, world: int = 8, compressor: str = "dgc",
-                  compressor_kwargs=None, **step_kwargs):
+                  compressor_kwargs=None, plan=None, **step_kwargs):
     """(state, step, setup, (images, labels, key)) on a tiny model.
 
     Mirrors tests/test_telemetry.py's ``flat_step_pair`` geometry; any
     ``build_train_step`` kwarg passes through (donate/telemetry/guards/
     ...; a ``guards`` config also seeds the state's guard counters), and
     ``compressor_kwargs`` augments the DGC compressor construction (e.g.
-    ``{"checksum": True}``)."""
+    ``{"checksum": True}``). ``plan`` is an exchange plan
+    (``dgc_tpu.compression.planner``) threaded through
+    ``make_flat_setup`` — the engine re-fits it to the fixture's bucket
+    geometry."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -103,7 +111,7 @@ def build_fixture(mesh=None, world: int = 8, compressor: str = "dgc",
         raise ValueError(f"unknown compressor {compressor!r}")
     dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
                                 world_size=world)
-    setup = make_flat_setup(v, dist)
+    setup = make_flat_setup(v, dist, plan=plan)
     state = shard_state(
         make_flat_state(v, dist, setup, world,
                         guards=step_kwargs.get("guards")),
@@ -278,6 +286,26 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         "dense-engine-no-gathers", state_d, step_dense, inputs,
         collectives=DENSE_COLLECTIVES, no_f64=True)
     run(dense.name, dense.check)
+
+    # plan-matches-collectives: whatever regime mix the exchange planner
+    # picks, its predicted collective counts (Plan.collectives) must
+    # equal the lowered HLO's — including the all-dense plan, where the
+    # sparse path must compile away to zero gathers. One candidate per
+    # build forces each regime family; the engine's realized plan
+    # (re-fit to the fixture's buckets) supplies the expectation, and
+    # the step adds exactly one loss-mean all-reduce on top.
+    from dgc_tpu.compression.planner import plan_buckets
+    for reg in ("dense", "fp32", "int8", "int8_packed"):
+        seed_plan = plan_buckets([], fabric="32x25GbE", world=8,
+                                 candidates=(reg,))
+        state_p, step_p, setup_p, _ = build_fixture(
+            mesh, donate=False, telemetry=False, plan=seed_plan)
+        want = dict(setup_p.engine.plan.collectives(dense_reduces=1))
+        want["all-reduce"] += 1     # the step's loss mean
+        pmc = _step_contract(
+            f"plan-matches-collectives[{reg}]", state_p, step_p, inputs,
+            collectives=want, no_f64=True)
+        run(pmc.name, pmc.check)
 
     run("fused-epilogue-no-opt-barriers",
         lambda: _epilogue_contract().check())

@@ -8,6 +8,14 @@ from dgc_tpu.compression.base import (
 from dgc_tpu.compression.dgc import DGCCompressor, TensorAttrs, sampling_geometry
 from dgc_tpu.compression.flat import FlatDGCEngine, FlatDenseExchange, ParamLayout
 from dgc_tpu.compression.memory import DGCSGDMemory, Memory
+from dgc_tpu.compression.planner import (
+    Fabric,
+    CostModel,
+    Plan,
+    plan_buckets,
+    plan_engine,
+    resolve_fabric,
+)
 
 __all__ = [
     "Compression",
@@ -23,4 +31,10 @@ __all__ = [
     "FlatDGCEngine",
     "FlatDenseExchange",
     "ParamLayout",
+    "Fabric",
+    "CostModel",
+    "Plan",
+    "plan_buckets",
+    "plan_engine",
+    "resolve_fabric",
 ]

@@ -74,8 +74,10 @@ class _DenseCompressor(Compressor):
     def _unwire(self, grad, dtype):
         return grad
 
-    def make_flat_exchange(self, layout):
-        """Flat-path capability: one psum over the whole gradient buffer."""
+    def make_flat_exchange(self, layout, plan=None):
+        """Flat-path capability: one psum over the whole gradient buffer.
+        ``plan`` is accepted for interface parity with the DGC engine and
+        ignored — the dense exchange has exactly one regime."""
         from dgc_tpu.compression.flat import FlatDenseExchange
         return FlatDenseExchange(self, layout)
 

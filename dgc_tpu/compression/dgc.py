@@ -97,6 +97,7 @@ class DGCCompressor(Compressor):
                  packed_indices: bool = False,
                  checksum: bool = False,
                  fused_apply: bool = False,
+                 fused_select: bool = False,
                  approx_recall: float = 0.90, verbose: bool = False):
         self.fp16_values = fp16_values
         #: fused apply epilogue (flat engine only): after the gathers,
@@ -110,6 +111,18 @@ class DGCCompressor(Compressor):
         #: back to the XLA path off-TPU, for non-f32 wires, and under
         #: int8 error feedback.
         self.fused_apply = fused_apply
+        #: fused select/pack (flat engine only): threshold -> top-k
+        #: select -> value pack ride ONE Pallas pass per bucket
+        #: (kernels.select_pack_rows) instead of a top-k kernel followed
+        #: by a separate [R, cols] value gather — the compress-side twin
+        #: of ``fused_apply``, attacking the fixed per-step overhead that
+        #: makes DGC lose on fast fabrics. Engaged only on the exact-
+        #: selection region (k <= 128 and under the iterative-max work
+        #: crossover); elsewhere and off-TPU the engine keeps the split
+        #: path. Bitwise-identical selections and values by construction
+        #: (same tie order as the top-k kernel, values read at the
+        #: selected coordinates).
+        self.fused_select = fused_select
         #: int8-quantized wire values with one f32 scale per TENSOR
         #: (scale = max|payload|/127, round-to-nearest, symmetric):
         #: addresses the reference's own stated caveat — "no
@@ -263,14 +276,20 @@ class DGCCompressor(Compressor):
         return {"momentum_masking":
                 bool(getattr(self.memory, "momentum_masking", True))}
 
-    def make_flat_exchange(self, layout):
+    def make_flat_exchange(self, layout, plan=None):
         """Flat-path capability (see ``dgc_tpu.compression.flat``): fused
         whole-model pipeline over a :class:`ParamLayout`. Discovered by the
         distributed optimizer via duck typing, like the reference's
         ``communicate``/``synchronize`` dispatch (optimizer.py:39-40).
-        Must be re-called after a compress-ratio change (new attributes)."""
+        Must be re-called after a compress-ratio change (new attributes).
+
+        ``plan`` — an optional ``compression.planner.Plan`` (or bare
+        regime tuple) giving each bucket its own exchange regime; None
+        keeps the uniform wire the compressor flags describe. A plan is
+        geometry-specific: re-plan (``Plan.replan``) after every warmup
+        compress-ratio change, alongside the engine rebuild."""
         from dgc_tpu.compression.flat import FlatDGCEngine
-        return FlatDGCEngine(self, layout)
+        return FlatDGCEngine(self, layout, plan=plan)
 
     def telemetry_attributes(self) -> Dict[str, Dict[str, float]]:
         """Static per-tensor selection geometry for telemetry headers

@@ -57,6 +57,19 @@ __all__ = ["ParamLayout", "FlatDGCEngine", "FlatDenseExchange"]
 #: kernels see aligned buffers and need no padding copies on the hot path
 _ALIGN = 16 * 128
 
+#: exchange regime -> (value kind, packed indices). "d" buckets ride the
+#: dense-fallback psum; sparse kinds pick the value lane ("f32" native,
+#: "f16" half wire, "i8" int8 + per-row f32 scales) and ``packed`` the
+#: index lane (bit-packed words vs flat offsets). One regime per bucket,
+#: chosen by ``compression.planner`` (or derived uniformly from the
+#: legacy compressor flags when no plan is given).
+_REGIMES = {
+    "dense": ("d", False),
+    "fp32": ("f32", False), "fp32_packed": ("f32", True),
+    "fp16": ("f16", False), "fp16_packed": ("f16", True),
+    "int8": ("i8", False), "int8_packed": ("i8", True),
+}
+
 
 def _round_up(n: int, align: int) -> int:
     return -(-n // align) * align
@@ -555,7 +568,7 @@ class FlatDGCEngine:
     layout pair. Rebuilt (cheaply, host-side) whenever the warm-up schedule
     changes the compress ratio (reference compression.py:91-107)."""
 
-    def __init__(self, compressor, layout: ParamLayout):
+    def __init__(self, compressor, layout: ParamLayout, plan=None):
         self.c = compressor
         self.layout = layout
         self.T = layout.t_compressed
@@ -578,43 +591,136 @@ class FlatDGCEngine:
         self.buckets = (_build_buckets(compressor.attributes, layout,
                                        compressor)
                         if compressor.compress_ratio < 1.0 else [])
+        # --- per-bucket exchange regimes (compression/planner.py) ---
+        # plan=None derives one uniform regime from the legacy compressor
+        # flags, so every pre-planner configuration keeps its exact wire;
+        # a Plan (or a plain regime sequence) may mix regimes per bucket.
+        if plan is None:
+            regimes = (self._legacy_regime(),) * len(self.buckets)
+            self.plan = None
+        else:
+            regimes = tuple(getattr(plan, "regimes", plan))
+            if len(regimes) != len(self.buckets):
+                raise ValueError(
+                    f"plan carries {len(regimes)} regimes for "
+                    f"{len(self.buckets)} buckets — the plan was built for "
+                    "a different geometry; call Plan.replan(engine) after "
+                    "every warmup compress-ratio change")
+            self.plan = plan if hasattr(plan, "regimes") else None
+        unknown = [r for r in regimes if r not in _REGIMES]
+        if unknown:
+            raise ValueError(f"unknown exchange regime(s) {unknown}; "
+                             f"expected one of {sorted(_REGIMES)}")
+        self.regimes: Tuple[str, ...] = regimes
+        rk = [_REGIMES[r] for r in regimes]
+        #: bucket ids by role: dense-planned buckets ride the fallback
+        #: psum slab-wise; the sparse pipeline runs over the rest
+        self._sparse_ids = [i for i, (k, _) in enumerate(rk) if k != "d"]
+        self._dense_ids = [i for i, (k, _) in enumerate(rk) if k == "d"]
+        sparse = [self.buckets[i] for i in self._sparse_ids]
+        self._sparse_buckets = sparse
+        #: per SPARSE bucket (payload order): value kind / packed flag
+        self._kinds = tuple(rk[i][0] for i in self._sparse_ids)
+        self._packed = tuple(rk[i][1] for i in self._sparse_ids)
         #: per-worker wire payload in elements — the reference's sum of
-        #: per-tensor num_selects (compression.py:151), plus at most
-        #: _PAD_PAYLOAD_MAX_FRAC of structural no-op slots per bucket
-        #: whose payload is the padded [R, max_sel] grid
-        #: (_bucket_from_rows; real transmitted elements per tensor stay
-        #: <= num_selects either way)
-        self.payload_size = sum(b.payload for b in self.buckets)
-        #: int8 wire (compressor.int8_values): payload position -> tensor
-        #: row (static, payload order = rows in bucket order, num_selects
-        #: entries each) for the per-TENSOR quantization scales; the
-        #: scale wire is one f32 per row — negligible next to the payload
-        self.payload_rows = sum(b.rows for b in self.buckets)
-        if getattr(compressor, "int8_values", False) and self.payload_size:
+        #: per-tensor num_selects (compression.py:151) over the SPARSE
+        #: buckets, plus at most _PAD_PAYLOAD_MAX_FRAC of structural
+        #: no-op slots per bucket whose payload is the padded
+        #: [R, max_sel] grid (_bucket_from_rows; real transmitted
+        #: elements per tensor stay <= num_selects either way)
+        sl, off = [], 0
+        for b in sparse:
+            sl.append((off, off + b.payload))
+            off += b.payload
+        self._payload_slices = tuple(sl)
+        self.payload_size = off
+        self.payload_rows = sum(b.rows for b in sparse)
+        #: kind-local chunk map: sparse bucket j's values ride value lane
+        #: self._kinds[j] at [lo, hi) of that lane's concatenated
+        #: payload; its indices ride the packed-words or plain-offsets
+        #: lane likewise. Uniform plans have exactly one chunk per lane,
+        #: and every chunk helper is the identity there — the lane
+        #: machinery compiles away to the pre-planner wire.
+        kof: Dict[str, int] = {}
+        vloc = []
+        for b, kk in zip(sparse, self._kinds):
+            lo = kof.get(kk, 0)
+            vloc.append((kk, lo, lo + b.payload))
+            kof[kk] = lo + b.payload
+        self._val_chunks = tuple(vloc)
+        self._kind_payload = kof
+        iof = {True: 0, False: 0}
+        iloc = []
+        for b, p in zip(sparse, self._packed):
+            iloc.append((p, iof[p], iof[p] + b.payload))
+            iof[p] += b.payload
+        self._idx_chunks = tuple(iloc)
+        self._plain_payload = iof[False]
+        #: int8 wire buckets: payload position -> tensor row (static,
+        #: payload order = rows in int8-bucket order, num_selects entries
+        #: each) for the per-TENSOR quantization scales; the scale wire
+        #: is one f32 per row — negligible next to the payload
+        i8 = [b for b, kk in zip(sparse, self._kinds) if kk == "i8"]
+        self._i8_rows = sum(b.rows for b in i8)
+        if i8 and self.payload_size:
             # per payload slot: owning tensor row — derived from the
             # bucket's tight map (slot s of the [R, max_sel] grid belongs
             # to row s // max_sel), so it is correct for both the tight
             # and the padded-payload layouts (_bucket_from_rows)
             rm, base = [], 0
-            for b in self.buckets:
+            for b in i8:
                 rm.append((b.tight // b.max_sel).astype(np.int32) + base)
                 base += b.rows
             self._row_map = jnp.asarray(np.concatenate(rm))
         else:
             self._row_map = None
+        #: static mask of int8 payload slots — only needed when int8
+        #: error feedback must coexist with deferred-masking (non-i8)
+        #: buckets in one mixed plan; None for every uniform plan
+        if i8 and len(i8) != len(sparse):
+            i8m = np.zeros((self.payload_size,), bool)
+            for (s0, s1), kk in zip(self._payload_slices, self._kinds):
+                if kk == "i8":
+                    i8m[s0:s1] = True
+            self._i8_slot_mask = i8m
+        else:
+            self._i8_slot_mask = None
         # bit-packed index wire (compression/wirecodec.py): per-slot
-        # static tensor-local widths; the all_gather ships the uint32
-        # bitstream instead of [payload] int32 offsets
-        if getattr(compressor, "packed_indices", False) and self.payload_size:
+        # static tensor-local widths over the PACKED buckets; their
+        # all_gather ships the uint32 bitstream instead of [payload]
+        # int32 offsets (plain-index buckets keep their own lane)
+        pk = [b for b, p in zip(sparse, self._packed) if p]
+        if pk and self.payload_size:
             from dgc_tpu.compression.wirecodec import IndexCodec
-            self._codec = IndexCodec(self.buckets)
+            self._codec = IndexCodec(pk)
         else:
             self._codec = None
+        # receiver-side index clamp bounds: packed slots enforce their
+        # static row bounds (exactly what an honest encode can produce);
+        # plain slots the generic [0, T) range. Mixed plans stitch one
+        # full-payload bounds pair; uniform plans keep the pre-planner
+        # arguments (codec arrays, or None/None for the generic clamp).
+        if self._codec is not None and self._plain_payload:
+            so = np.zeros((self.payload_size,), np.int64)
+            sn = np.full((self.payload_size,), max(int(self.T), 1),
+                         np.int64)
+            pj = 0
+            for (s0, s1), p in zip(self._payload_slices, self._packed):
+                if p:
+                    so[s0:s1] = self._codec.slot_off[pj:pj + s1 - s0]
+                    sn[s0:s1] = self._codec.slot_numel[pj:pj + s1 - s0]
+                    pj += s1 - s0
+            self._clamp_bounds = (so, sn)
+        elif self._codec is not None:
+            self._clamp_bounds = (self._codec.slot_off,
+                                  self._codec.slot_numel)
+        else:
+            self._clamp_bounds = (None, None)
         #: opt-in payload checksum (resilience.integrity): one int32 word
-        #: per bucket over the exact wire bits, shipped on the index
-        #: gather. Verified only when the caller passes ``health_out`` to
-        #: ``exchange`` (the guarded step does); the counter surfaces as
-        #: the ``checksum_failures`` guard metric.
+        #: per sparse bucket over the exact wire bits, shipped on the
+        #: index gather. Verified only when the caller passes
+        #: ``health_out`` to ``exchange`` (the guarded step does); the
+        #: counter surfaces as the ``checksum_failures`` guard metric.
         self.checksum = (bool(getattr(compressor, "checksum", False))
                          and self.payload_size > 0)
         if self.checksum and self._row_map is not None:
@@ -622,49 +728,131 @@ class FlatDGCEngine:
                 "checksum=True is not supported with int8_values — the "
                 "per-row f32 scale wire would ride uncovered; use the "
                 "fp16/f32 value wire")
+        sparse_set = set(r for r in regimes if r != "dense")
+        if self.checksum and len(sparse_set) > 1:
+            raise ValueError(
+                "checksum=True needs one wire format across the sparse "
+                f"buckets; the plan mixes {sorted(sparse_set)} — plan "
+                "with candidates=('dense', <one regime>) or disable the "
+                "checksum")
+        self._num_seg = len(sparse)
         if self.checksum:
             from dgc_tpu.resilience.integrity import bucket_segments
-            self._seg_ids = bucket_segments(self.buckets)
+            self._seg_ids = bucket_segments(sparse)
         else:
             self._seg_ids = None
-        #: any bucket selects through the segment-top-2 kernel: the TPU
-        #: compensate pass then emits the candidates itself
+        #: any sparse bucket selects through the segment-top-2 kernel:
+        #: the TPU compensate pass then emits the candidates itself
         #: (kernels.fused_compensate_bits_cands) instead of a standalone
         #: kernel re-reading the velocity it just wrote
-        self._seg_fused = any(self._use_seg_kernel(b) for b in self.buckets)
+        self._seg_fused = any(self._use_seg_kernel(b) for b in sparse)
+
+    def _legacy_regime(self) -> str:
+        """The uniform wire regime the compressor flags describe — what
+        every ``plan=None`` engine runs, bit-for-bit the pre-planner
+        behavior."""
+        c = self.c
+        if getattr(c, "int8_values", False):
+            base = "int8"
+        elif getattr(c, "fp16_values", False):
+            base = "fp16"
+        else:
+            base = "fp32"
+        return base + ("_packed"
+                       if getattr(c, "packed_indices", False) else "")
+
+    def _kind_chunks(self, arr: jax.Array, kind: str) -> jax.Array:
+        """Concatenated payload chunks of the sparse buckets whose value
+        kind is ``kind`` — the identity when every sparse bucket shares
+        it (uniform plans keep their exact pre-planner wire arrays)."""
+        if all(k == kind for k in self._kinds):
+            return arr
+        parts = [arr[s0:s1] for (s0, s1), k
+                 in zip(self._payload_slices, self._kinds) if k == kind]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def _packed_chunks(self, arr: jax.Array, packed: bool) -> jax.Array:
+        """Same, for the index lanes (packed words vs plain offsets)."""
+        if all(p == packed for p in self._packed):
+            return arr
+        parts = [arr[s0:s1] for (s0, s1), p
+                 in zip(self._payload_slices, self._packed) if p == packed]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
     # -------------------------------------------------------------- #
     # telemetry geometry (dgc_tpu.telemetry)                         #
     # -------------------------------------------------------------- #
 
     def wire_bytes_per_worker(self) -> int:
-        """Static per-worker sparse wire bytes per step: the values
-        all_gather payload (int8/fp16/full precision, plus the per-row f32
-        scale wire under int8) + the index all_gather payload (packed
-        bitstream or flat offsets). The dense-fallback psum is NOT counted
-        here — it is the same on both arms of every comparison."""
+        """Static per-worker sparse wire bytes per step, lane-exact under
+        the active plan: the value lanes (int8 payload + per-row f32
+        scales / fp16 / native precision) + the index lanes (packed
+        bitstream words / flat offsets). Dense-planned buckets ride the
+        fallback psum and cost 0 here — the psum is the same on both arms
+        of every comparison. Uniform plans report exactly the pre-planner
+        figures."""
         if not self.payload_size:
             return 0
-        if self._row_map is not None:
-            val_bytes = self.payload_size + 4 * self.payload_rows
-        elif self.c.fp16_values:
-            val_bytes = 2 * self.payload_size
-        else:
-            val_bytes = self.payload_size * np.dtype(self.layout.dtype).itemsize
+        kp = self._kind_payload
+        val = 0
+        if kp.get("i8"):
+            val += kp["i8"] + 4 * self._i8_rows
+        if kp.get("f16"):
+            val += 2 * kp["f16"]
+        if kp.get("f32"):
+            val += kp["f32"] * np.dtype(self.layout.dtype).itemsize
+        idx = 0
         if self._codec is not None:
-            idx_bytes = 4 * self._codec.nwords
-        else:
-            idx_bytes = self.payload_size * jnp.dtype(self.index_dtype).itemsize
-        return int(val_bytes + idx_bytes)
+            idx += 4 * self._codec.nwords
+        if self._plain_payload:
+            idx += (self._plain_payload
+                    * jnp.dtype(self.index_dtype).itemsize)
+        return int(val + idx)
+
+    def bucket_wire_bytes(self) -> List[int]:
+        """Per-bucket sparse wire bytes under the active plan (the
+        per-regime breakdown the planner's prediction is checked
+        against). Dense-planned buckets report 0; packed-index buckets
+        attribute their exact slot bit widths rounded up to whole bytes,
+        while :meth:`wire_bytes_per_worker` pads the shared bit stream
+        once to whole 4-byte words — so the sum may differ from the
+        engine total by sub-word rounding in either direction:
+        ``-(num packed buckets) < total - sum < 4`` bytes."""
+        out = []
+        pj = 0
+        for b, r in zip(self.buckets, self.regimes):
+            kind, packed = _REGIMES[r]
+            if kind == "d":
+                out.append(0)
+                continue
+            if kind == "i8":
+                vb = b.payload + 4 * b.rows
+            elif kind == "f16":
+                vb = 2 * b.payload
+            else:
+                vb = b.payload * np.dtype(self.layout.dtype).itemsize
+            if packed:
+                w = self._codec.widths[pj:pj + b.payload]
+                pj += b.payload
+                ib = -(-int(w.sum()) // 8)
+            else:
+                ib = b.payload * jnp.dtype(self.index_dtype).itemsize
+            out.append(int(vb + ib))
+        return out
 
     def bucket_descriptors(self):
         """Static per-bucket geometry for telemetry headers/readers: the
         per-bucket stat columns (selected_frac, threshold) are emitted in
-        this order."""
+        this order. Carries each bucket's planned exchange regime and its
+        per-regime wire bytes (buckets may disagree under a mixed
+        plan)."""
+        wb = self.bucket_wire_bytes()
         return [{"base": int(b.base), "rows": int(b.rows),
                  "cols": int(b.cols), "numel": int(np.sum(b.numels)),
                  "num_selects": int(np.sum(b.num_selects)),
-                 "payload": int(b.payload)} for b in self.buckets]
+                 "payload": int(b.payload), "regime": r,
+                 "wire_bytes": int(w)}
+                for b, r, w in zip(self.buckets, self.regimes, wb)]
 
     def telemetry_static(self) -> Dict:
         """Header block for the telemetry sink (see registry.make_header)."""
@@ -678,6 +866,7 @@ class FlatDGCEngine:
             "index_bits": (round(self._codec.bits_per_index, 2)
                            if self._codec is not None else
                            8 * jnp.dtype(self.index_dtype).itemsize),
+            "regimes": list(self.regimes),
             "buckets": self.bucket_descriptors(),
         }
 
@@ -1083,6 +1272,21 @@ class FlatDGCEngine:
                 and dt == jnp.float32
                 and self.T % kernels._LANE == 0)
 
+    def _use_fused_select(self, b: "_Bucket") -> bool:
+        """Whether a bucket's selection runs the fused
+        threshold->select->pack kernel (kernels.select_pack_rows): ONE
+        pass over the bucket rows emits scores, signed payload values,
+        and columns together — replacing the masked-importance
+        materialization, the top-k, and the payload value gather.
+        Opt-in (``DGCCompressor(fused_select=True)``) and exact-selection
+        region only: the same lane-width / work-crossover bounds
+        :meth:`_select_topk` uses to route to ``_exact_topk``, so the
+        fused and unfused paths select bitwise-identical payloads
+        (pinned in tests/test_kernels.py)."""
+        return (getattr(self.c, "fused_select", False)
+                and b.max_sel <= 128
+                and b.max_sel * b.cols <= 2_000_000)
+
     def _sample_rows_3d(self, b: "_Bucket", v2d: jax.Array,
                         k: jax.Array) -> jax.Array:
         """Lane-block strided samples from the layout-free [R, nb, 128]
@@ -1313,7 +1517,7 @@ class FlatDGCEngine:
         # ~2.5 ms/step at VGG, device profile r5)
         v2d = (vec_c.reshape(-1, 128)
                if any(self._use_seg_kernel(b) or self._use_3d(b)
-                      for b in self.buckets) else None)
+                      for b in self._sparse_buckets) else None)
         def emit(vals, gidx, b):
             # identity tight map (padded payload, _bucket_from_rows):
             # the [R, max_sel] grid IS the payload — no compaction gather
@@ -1326,6 +1530,10 @@ class FlatDGCEngine:
                 out_i.append(gidx.reshape(-1)[tight])
 
         for bi, b in enumerate(self.buckets):
+            if self.regimes[bi] == "dense":
+                # dense-planned bucket: its slab rides the fallback psum
+                # in exchange() — no selection, no payload contribution
+                continue
             k = jax.random.fold_in(key, bi)
             if self._use_seg_kernel(b) or self._use_3d(b):
                 # layout-free selection — no 2-D relayout of the bucket
@@ -1359,7 +1567,19 @@ class FlatDGCEngine:
                 # (adaptation is statically off: numel == num_samples).
                 scores = imp_rows
                 with _trace.phase("select", bi):
-                    top_scores, cols = self._select_topk(scores, b.max_sel)
+                    if self._use_fused_select(b):
+                        # fused threshold->select->pack: the kernel masks
+                        # by numel, extracts the top set, and emits the
+                        # SIGNED payload values in the same pass — the
+                        # [R, cols] importance array and the value gather
+                        # both disappear (bitwise the unfused selection)
+                        top_scores, fvals, cols = kernels.select_pack_rows(
+                            block, jnp.asarray(b.numels, jnp.int32),
+                            b.max_sel)
+                    else:
+                        fvals = None
+                        top_scores, cols = self._select_topk(scores,
+                                                             b.max_sel)
                     slot = jnp.arange(b.max_sel, dtype=jnp.int32)[None, :]
                     valid = (top_scores >= 0) & (
                         slot < jnp.asarray(b.num_selects)[:, None])
@@ -1367,8 +1587,9 @@ class FlatDGCEngine:
                                  row_off + cols.astype(self.index_dtype),
                                  jnp.asarray(S, self.index_dtype))
                     vals = jnp.where(valid,
-                                     jnp.take_along_axis(block, cols,
-                                                         axis=1),
+                                     (fvals if fvals is not None else
+                                      jnp.take_along_axis(block, cols,
+                                                          axis=1)),
                                      jnp.zeros((), vec_c.dtype))
                 with _trace.phase("pack", bi):
                     emit(vals, gidx, b)
@@ -1409,7 +1630,16 @@ class FlatDGCEngine:
             # depend on thr), so the resample ladder can be derived from
             # the top-k values with no extra pass over the block.
             with _trace.phase("select", bi):
-                top_scores, cols = self._select_topk(imp_rows, b.max_sel)
+                if self._use_fused_select(b):
+                    # fused selection (see the exact branch above): the
+                    # signed payload values ride out of the same pass;
+                    # threshold adaptation below still uses top_scores
+                    top_scores, fvals, cols = kernels.select_pack_rows(
+                        block, jnp.asarray(b.numels, jnp.int32), b.max_sel)
+                else:
+                    fvals = None
+                    top_scores, cols = self._select_topk(imp_rows,
+                                                         b.max_sel)
 
             # --- bounded threshold adaptation (compression.py:128-149) ---
             if self.c.max_adaptation_iters > 0 and b.adapt.any():
@@ -1443,7 +1673,8 @@ class FlatDGCEngine:
                 # values via a row-local gather from the reshape view (no
                 # global gather); invalid slots carry 0.0 like the sentinel
                 vals = jnp.where(valid,
-                                 jnp.take_along_axis(block, cols, axis=1),
+                                 (fvals if fvals is not None else
+                                  jnp.take_along_axis(block, cols, axis=1)),
                                  jnp.zeros((), vec_c.dtype))
 
             with _trace.phase("pack", bi):
@@ -1454,7 +1685,17 @@ class FlatDGCEngine:
             # selection count / effective threshold, whole-model payload
             from dgc_tpu.telemetry import taps
             counts, thrs, fracs = [], [], []
-            for b, v, i in zip(self.buckets, out_v, out_i):
+            sj = 0
+            for b, r in zip(self.buckets, self.regimes):
+                if r == "dense":
+                    # dense-planned bucket: everything rides the psum —
+                    # selected fraction 1.0, no threshold, no sparse
+                    # payload contribution
+                    fracs.append(jnp.ones((), jnp.float32))
+                    thrs.append(jnp.zeros((), jnp.float32))
+                    continue
+                v, i = out_v[sj], out_i[sj]
+                sj += 1
                 c, t = taps.bucket_payload_stats(v, i, S)
                 counts.append(c)
                 thrs.append(t)
@@ -1549,7 +1790,11 @@ class FlatDGCEngine:
         # ratio >= 1.0 (or nothing initialized): everything dense, with the
         # per-tensor path's non-accumulating correction (dgc.py compress
         # guard `compress_ratio < 1.0 and name in attributes`)
-        if T == 0 or self.c.compress_ratio >= 1.0:
+        if T == 0 or self.c.compress_ratio >= 1.0 or not self._sparse_ids:
+            # ``not self._sparse_ids``: an all-dense PLAN — the planner
+            # decided every bucket rides the psum (fast-fabric regime).
+            # Lowers with ZERO gathers, the plan-matches-collectives
+            # contract's all-dense case.
             avg = self._dense_combine(flat_grad, axis_name, world_size, op)
             if m is None:
                 if telemetry:
@@ -1596,6 +1841,12 @@ class FlatDGCEngine:
             md = mem["momentums_d"]
         else:
             mc = vc = md = None
+        # pre-compensate state: dense-PLANNED slabs inside [0, T) get the
+        # dense (non-accumulating) correction from the PREVIOUS step's
+        # state, overriding whatever the accumulating compensate below
+        # wrote there (it runs over the whole [T] buffer)
+        mc_prev, vc_prev = mc, vc
+        bits_prev = mem.get("sent_bits") if m is not None else None
 
         # --- compressed block: masked compensate -> sparsify -> gather ---
         cands = None
@@ -1633,20 +1884,24 @@ class FlatDGCEngine:
                                         stats_out=sel_stats)
 
         dt = flat_grad.dtype
+        kp = self._kind_payload
         int8_ef = False
-        if self._row_map is not None:
-            # int8 wire: symmetric per-TENSOR quantization (one f32 scale
-            # per row, segment-max over the tight payload) — the
+        f32_wire = f16_wire = q_wire = scale = None
+        if kp.get("i8"):
+            # int8 wire lane: symmetric per-TENSOR quantization (one f32
+            # scale per row, segment-max over the tight payload) — the
             # reference's stated "no quantization/encoding of payloads"
             # caveat (README.md:130-138) addressed; dequantize after the
-            # gather, before the scatter-add
+            # gather, before the scatter-add. The scales ride the f32
+            # value lane (appended after any native-f32 chunks).
+            vals_i8 = self._kind_chunks(values, "i8")
             with _trace.phase("pack"):
-                smax = jax.ops.segment_max(jnp.abs(values), self._row_map,
-                                           num_segments=self.payload_rows)
+                smax = jax.ops.segment_max(jnp.abs(vals_i8), self._row_map,
+                                           num_segments=self._i8_rows)
                 scale = (smax / 127.0).astype(jnp.float32)
                 safe = jnp.where(scale > 0, scale, 1.0)
-                q = jnp.clip(jnp.round(values / safe[self._row_map]),
-                             -127, 127).astype(jnp.int8)
+                q_wire = jnp.clip(jnp.round(vals_i8 / safe[self._row_map]),
+                                  -127, 127).astype(jnp.int8)
             int8_ef = (m is not None
                        and getattr(self.c, "int8_error_feedback", False))
             if int8_ef:
@@ -1656,30 +1911,68 @@ class FlatDGCEngine:
                 # holds ``values`` at these coordinates (comp IS the
                 # velocity), so one scatter-subtract of the dequantized
                 # payload leaves exactly the residual there — and the
-                # transmit record stays EMPTY this step (no deferred
-                # zeroing; the residual must survive the next compensate).
-                # Momentum masking (memory.py:72-77) happens eagerly
-                # instead, bitwise the same as the deferred form since
-                # nothing reads mmt in between. Padded slots carry
-                # (sentinel, q=0): a zero subtract at the structural-zero
-                # slot, a no-op.
-                dequant = (q.astype(jnp.float32)
+                # transmit record stays EMPTY this step for the int8
+                # slots (no deferred zeroing; the residual must survive
+                # the next compensate). Momentum masking (memory.py:72-77)
+                # happens eagerly instead, bitwise the same as the
+                # deferred form since nothing reads mmt in between.
+                # Padded slots carry (sentinel, q=0): a zero subtract at
+                # the structural-zero slot, a no-op.
+                dequant = (q_wire.astype(jnp.float32)
                            * scale[self._row_map]).astype(vc.dtype)
-                vc = vc.at[indices].add(-dequant)
+                idx_i8 = self._kind_chunks(indices, "i8")
+                vc = vc.at[idx_i8].add(-dequant)
                 if m.momentum_masking:
-                    mc = mc.at[indices].set(jnp.zeros((), mc.dtype))
-            with _trace.phase("allgather"):
-                g_q = jax.lax.all_gather(q, axis_name)       # [W, payload]
-                g_scales = jax.lax.all_gather(scale, axis_name)  # [W, rows]
+                    mc = mc.at[idx_i8].set(jnp.zeros((), mc.dtype))
+        # f32 value lane: native-dtype values of the f32-regime buckets,
+        # then the int8 per-row scales. A single part ships identity
+        # (uniform plans keep their exact pre-planner wire arrays);
+        # multiple parts promote to f32 for the concat.
+        f32_parts = ([self._kind_chunks(values, "f32")]
+                     if kp.get("f32") else [])
+        if scale is not None:
+            f32_parts.append(scale)
+        if len(f32_parts) == 1:
+            f32_wire = f32_parts[0]
+        elif f32_parts:  # dgclint: ok[tracer-branch] — list emptiness is plan-static (kp/scale), not a tracer test
+            f32_wire = jnp.concatenate(
+                [p.astype(jnp.float32) for p in f32_parts])
+        if kp.get("f16"):
+            f16_wire = self._kind_chunks(values, "f16").astype(jnp.float16)
+        with _trace.phase("allgather"):
+            g_q = (jax.lax.all_gather(q_wire, axis_name)
+                   if q_wire is not None else None)   # [W, i8 payload]
+            g_f32 = (jax.lax.all_gather(f32_wire, axis_name)
+                     if f32_wire is not None else None)
+            g_f16 = (jax.lax.all_gather(f16_wire, axis_name)
+                     if f16_wire is not None else None)
+        kinds = set(self._kinds)
+        if kinds == {"f16"}:
+            g_values = g_f16
+        elif kinds == {"f32"}:
+            g_values = g_f32
+        elif kinds == {"i8"}:
             with _trace.phase("decode"):
                 g_values = g_q.astype(dt) * jnp.take(
-                    g_scales.astype(dt), self._row_map, axis=1)
+                    g_f32.astype(dt), self._row_map, axis=1)
         else:
-            wire_values = (values.astype(jnp.float16)
-                           if self.c.fp16_values else values)
-            with _trace.phase("allgather"):
-                g_values = jax.lax.all_gather(wire_values,
-                                              axis_name)    # [W, payload]
+            # mixed plan: stitch the gathered lanes back into payload
+            # order per sparse bucket ([W, payload], wire precision —
+            # the shared .astype(dt) happens at the scatter below)
+            with _trace.phase("decode"):
+                if g_q is not None:
+                    g_i8 = g_q.astype(dt) * jnp.take(
+                        g_f32[:, kp.get("f32", 0):].astype(dt),
+                        self._row_map, axis=1)
+                parts = []
+                for kk, lo, hi in self._val_chunks:
+                    if kk == "i8":
+                        parts.append(g_i8[:, lo:hi])
+                    elif kk == "f16":
+                        parts.append(g_f16[:, lo:hi].astype(dt))
+                    else:
+                        parts.append(g_f32[:, lo:hi].astype(dt))
+                g_values = jnp.concatenate(parts, axis=1)
         if _faults.armed():
             # deterministic post-gather corruption (tests only; identity
             # ops, zero HLO, when DGC_FAULTS is unset)
@@ -1691,17 +1984,22 @@ class FlatDGCEngine:
             # receiver reconstructs (codec slots clip in-row — see
             # IndexCodec.canonical). Rides the index gather below.
             with _trace.phase("pack"):
+                # constructor guarantees checksum plans are uniform
+                # non-int8: exactly one value lane carries the payload
+                wire_values = f16_wire if f16_wire is not None else f32_wire
                 idx_canon = (self._codec.canonical(indices)
                              if self._codec is not None else indices)
                 chk = integrity.payload_checksum(
                     wire_values, idx_canon, self._seg_ids,
-                    len(self.buckets))
+                    self._num_seg)
+        g_idx_packed = g_idx_plain = None
         if self._codec is not None:
             # packed index wire: gather the bitstream, decode per worker
             # (static gathers + shifts; decoded == original for every
             # real slot, padded slots land in-row with value 0.0)
             with _trace.phase("pack"):
-                words = self._codec.encode(indices)
+                words = self._codec.encode(
+                    self._packed_chunks(indices, True))
                 if checksum:
                     # int32 -> uint32 astype is a bit-preserving mod-2^32
                     # wrap, undone symmetrically on the receiver
@@ -1712,28 +2010,37 @@ class FlatDGCEngine:
                 if checksum:
                     g_chk = g_words[:, self._codec.nwords:].astype(jnp.int32)
                     g_words = g_words[:, :self._codec.nwords]
-                g_indices = self._codec.decode(g_words, self.index_dtype)
-        else:
+                g_idx_packed = self._codec.decode(g_words, self.index_dtype)
+        if self._plain_payload:
             with _trace.phase("pack"):
-                idx_wire = indices
-                if checksum:
+                idx_wire = self._packed_chunks(indices, False)
+                if checksum and self._codec is None:
                     idx_wire = jnp.concatenate(
-                        [indices, chk.astype(self.index_dtype)])
+                        [idx_wire, chk.astype(self.index_dtype)])
             with _trace.phase("allgather"):
                 g_idx_wire = jax.lax.all_gather(idx_wire, axis_name)
             with _trace.phase("decode"):
-                if checksum:
-                    g_chk = g_idx_wire[:, self.payload_size:].astype(
+                if checksum and self._codec is None:
+                    g_chk = g_idx_wire[:, self._plain_payload:].astype(
                         jnp.int32)
-                    g_indices = g_idx_wire[:, :self.payload_size]
+                    g_idx_plain = g_idx_wire[:, :self._plain_payload]
                 else:
-                    g_indices = g_idx_wire
+                    g_idx_plain = g_idx_wire
+        if g_idx_packed is None:
+            g_indices = g_idx_plain
+        elif g_idx_plain is None:
+            g_indices = g_idx_packed
+        else:
+            with _trace.phase("decode"):
+                g_indices = jnp.concatenate(
+                    [(g_idx_packed if p else g_idx_plain)[:, lo:hi]
+                     for p, lo, hi in self._idx_chunks], axis=1)
         if _faults.armed():
             g_indices = _faults.corrupt_indices(g_indices)
         if checksum:
             health_out["checksum_failures"] = integrity.count_mismatches(
                 g_values, g_indices, g_chk, self._seg_ids,
-                len(self.buckets))
+                self._num_seg)
         # always-on bounds clamp BEFORE the scatter-add: XLA drops >= T
         # indices under jit but wraps NEGATIVE ones python-style, so a
         # corrupted payload word decoding to -5 would silently add
@@ -1744,9 +2051,7 @@ class FlatDGCEngine:
         # can produce. Honest traffic passes through bitwise unchanged.
         with _trace.phase("decode"):
             g_indices = integrity.clamp_indices(
-                g_indices, T, self.layout.sentinel,
-                *((self._codec.slot_off, self._codec.slot_numel)
-                  if self._codec is not None else (None, None)))
+                g_indices, T, self.layout.sentinel, *self._clamp_bounds)
         # Averaging divides the [W, payload] WIRE values BEFORE the
         # scatter (algebraically identical to the reference's
         # scatter-then-divide, compression.py:192-193; differs by
@@ -1795,26 +2100,79 @@ class FlatDGCEngine:
                 # bit-packed, one word-wide scatter over a 32x smaller
                 # buffer (padded slots carry the sentinel and are dropped
                 # — their repeated single-bit adds would carry across
-                # bits). Under int8 error feedback the record stays empty
-                # — masking was applied eagerly above and the velocity
-                # keeps the residual.
+                # bits). Under int8 error feedback the int8 slots keep an
+                # EMPTY record — masking was applied eagerly above and the
+                # velocity keeps the residual; in a mixed plan the non-i8
+                # buckets still record theirs (deferred masking).
                 with _trace.phase("pack"):
-                    new_bits = (jnp.zeros_like(mem["sent_bits"]) if int8_ef
-                                else kernels.pack_sent_bits(
-                                    indices, T,
-                                    sentinel=self.layout.sentinel))
+                    if int8_ef and self._i8_slot_mask is None:
+                        new_bits = jnp.zeros_like(mem["sent_bits"])
+                    elif int8_ef:
+                        rec = jnp.where(
+                            jnp.asarray(self._i8_slot_mask),
+                            jnp.asarray(self.layout.sentinel,
+                                        indices.dtype),
+                            indices)
+                        new_bits = kernels.pack_sent_bits(
+                            rec, T, sentinel=self.layout.sentinel)
+                    else:
+                        new_bits = kernels.pack_sent_bits(
+                            indices, T, sentinel=self.layout.sentinel)
 
         # --- dense fallback block: one collective + correction ---
-        if P > T:
+        # dense-PLANNED buckets ride the SAME psum as the dense tail (one
+        # concatenated wire, still exactly one collective), then split
+        # back into per-bucket slabs that get the dense-path semantics:
+        # clip on the averaged gradient, pending transmit mask from the
+        # PREVIOUS state materialized, non-accumulating compensate — the
+        # [0, T) writes the accumulating compensate made there are
+        # overridden from (mc_prev, vc_prev).
+        dslabs = [(i, self.buckets[i]) for i in self._dense_ids]
+        if P > T or dslabs:
             with _trace.phase("dense"):
-                gd_avg = self._dense_combine(gd, axis_name, world_size, op)
-                if clip is not None:
-                    # the fallback's compensate sees the AVERAGED gradient
-                    # (reference compression.py:198 -> memory.py:52-53)
-                    gd_avg = self._clip_block(gd_avg,
-                                              self.layout.dense_names, T)
-                out_d, md = self._compensate_dense(md, gd_avg)
-            out = jnp.concatenate([acc, out_d])
+                dparts = [flat_grad[b.base:b.base + b.rows * b.cols]
+                          for _, b in dslabs]
+                # dparts emptiness is plan-static (dense regime ids)
+                dwire = (jnp.concatenate(dparts + [gd])  # dgclint: ok[tracer-branch]
+                         if dparts else gd)
+                davg = self._dense_combine(dwire, axis_name, world_size,
+                                           op)
+                keep = None
+                off = 0
+                for i, b in dslabs:
+                    n = b.rows * b.cols
+                    slab = davg[off:off + n]
+                    off += n
+                    if clip is not None:
+                        slab = self._clip_block(
+                            slab, self.layout.buckets[i].names, b.base)
+                    if m is None:
+                        acc = acc.at[b.base:b.base + n].set(
+                            slab.astype(acc.dtype))
+                        continue
+                    if keep is None:
+                        keep = kernels.keep_from_bits(bits_prev, T)
+                    kslab = keep[b.base:b.base + n].astype(vc_prev.dtype)
+                    vslab = vc_prev[b.base:b.base + n] * kslab
+                    mslab = mc_prev[b.base:b.base + n]
+                    if m.momentum_masking:
+                        mslab = mslab * kslab
+                    out_slab, mslab2 = self._compensate_dense(mslab, slab)
+                    acc = acc.at[b.base:b.base + n].set(
+                        out_slab.astype(acc.dtype))
+                    mc = mc.at[b.base:b.base + n].set(mslab2)
+                    vc = vc.at[b.base:b.base + n].set(vslab)
+                if P > T:
+                    gd_avg = davg[off:]
+                    if clip is not None:
+                        # the fallback's compensate sees the AVERAGED
+                        # gradient (reference compression.py:198 ->
+                        # memory.py:52-53)
+                        gd_avg = self._clip_block(gd_avg,
+                                                  self.layout.dense_names,
+                                                  T)
+                    out_d, md = self._compensate_dense(md, gd_avg)
+            out = jnp.concatenate([acc, out_d]) if P > T else acc
         else:
             out = acc
 
@@ -1827,9 +2185,18 @@ class FlatDGCEngine:
             # 0.0): under deferred masking vc still holds the transmitted
             # values, so the untransmitted residual is ||vc||² minus it;
             # under int8 error feedback vc was already rewritten to the
-            # residual above and is the norm directly.
-            tx_energy = (None if (m is None or int8_ef)
-                         else jnp.sum(values.astype(jnp.float32) ** 2))
+            # residual above and is the norm directly. Mixed plans with
+            # int8 EF count only the deferred (non-i8) slots.
+            if m is None:
+                tx_energy = None
+            elif int8_ef and self._i8_slot_mask is not None:
+                tx_energy = jnp.sum(jnp.where(
+                    jnp.asarray(self._i8_slot_mask), 0.0,
+                    values.astype(jnp.float32)) ** 2)
+            elif int8_ef:
+                tx_energy = None
+            else:
+                tx_energy = jnp.sum(values.astype(jnp.float32) ** 2)
             return out, mem, self._telemetry_stats(
                 taps, grad_norm, clip_delta, mc, md, vc, sel_stats,
                 tx_energy=tx_energy)

@@ -55,6 +55,7 @@ __all__ = ["fused_compensate", "fused_compensate_reference",
            "num_sent_words",
            "ladder_counts", "ladder_counts_reference",
            "topk_rows", "topk_rows_reference",
+           "select_pack_rows", "select_pack_rows_reference",
            "seg_top2_candidates", "seg_top2_reference",
            "seg_top2_eligible", "opaque_view", "use_pallas",
            "payload_apply_bits", "payload_apply_bits_reference"]
@@ -668,6 +669,118 @@ def topk_rows(x: jax.Array, k: int):
         interpret=_interpret(),
     )(x)
     return v[:R, :k], i[:R, :k]
+
+
+# ------------------------------------------------------------------ #
+# fused threshold -> select -> pack (the compress-side epilogue)     #
+# ------------------------------------------------------------------ #
+
+def select_pack_rows_reference(x: jax.Array, numels: jax.Array, k: int):
+    """jnp reference: the engine's unfused exact-selection sequence — mask
+    the row tail to importance -1, ``lax.top_k`` over |x|, then gather the
+    SIGNED values at the selected columns."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    imp = jnp.where(lane < numels[:, None], jnp.abs(x),
+                    jnp.full((), -1.0, x.dtype))
+    scores, cols = jax.lax.top_k(imp, k)
+    return scores, jnp.take_along_axis(x, cols, axis=1), cols
+
+
+def _select_pack_kernel(x_ref, n_ref, s_ref, v_ref, i_ref, *, k, cols):
+    x = x_ref[:]                                          # [8, cols] signed
+    n = n_ref[:]                                          # [8, 1] int32
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    out_lane = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], _LANE), 1)
+    # importance masking fused in: row tails (and the -inf column pad)
+    # read -1, exactly the engine's imp_rows array — which this kernel
+    # makes disappear from HBM
+    imp = jnp.where(lane < n, jnp.abs(x), jnp.full((), -1.0, x.dtype))
+
+    def body(j, carry):
+        taken, s, v, i = carry
+        # same extraction order as _topk_kernel (see its taken-mask note):
+        # max over untaken importance, first attaining index wins ties
+        free = taken == 0
+        m = jnp.max(jnp.where(free, imp, -jnp.inf), axis=1,
+                    keepdims=True)                        # [8, 1]
+        idx = jnp.min(jnp.where(free & (imp >= m), lane, cols), axis=1,
+                      keepdims=True)                      # [8, 1]
+        # the SIGNED payload value at the extracted column — a one-hot
+        # row sum instead of a gather (no dynamic indexing on TPU)
+        val = jnp.sum(jnp.where(lane == idx, x, jnp.zeros((), x.dtype)),
+                      axis=1, keepdims=True)              # [8, 1]
+        s = jnp.where(out_lane == j, m, s)
+        v = jnp.where(out_lane == j, val, v)
+        i = jnp.where(out_lane == j, idx, i)
+        return jnp.where(lane == idx, 1, taken), s, v, i
+
+    _, s, v, i = jax.lax.fori_loop(
+        0, k, body, (jnp.zeros(x.shape, jnp.int32),
+                     jnp.full((x.shape[0], _LANE), -jnp.inf, x.dtype),
+                     jnp.zeros((x.shape[0], _LANE), x.dtype),
+                     jnp.zeros((x.shape[0], _LANE), jnp.int32)))
+    s_ref[:] = s
+    v_ref[:] = v
+    i_ref[:] = i
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+@_trace.phased("select")
+def select_pack_rows(x: jax.Array, numels: jax.Array, k: int):
+    """Fused threshold->select->pack over a bucket's [R, cols] SIGNED value
+    block: per row, ``(scores, values, cols)`` of the k most important
+    (|x|) elements among the first ``numels[r]`` columns — bitwise
+    :func:`select_pack_rows_reference` (and therefore bitwise the engine's
+    unfused ``imp_rows`` + ``topk_rows`` + ``take_along_axis`` sequence)
+    for NaN-free input.
+
+    One VMEM-resident pass replaces THREE [R, cols]-scale touches of the
+    unfused compress side: the masked-importance materialization, the
+    top-k read, and the value gather — the compress-side twin of
+    :func:`payload_apply_bits`, attacking the fixed per-step overhead
+    that makes DGC lose to dense psum on fast fabrics. Each of the k
+    extractions emits the signed value through a one-hot row sum in the
+    same loop iteration that finds the column, so the block is read once.
+
+    Delegation mirrors :func:`topk_rows` (checked FIRST, so a delegating
+    call never pays the pad/up-cast): k beyond the lane width or the row
+    block beyond the VMEM budget falls back to the reference; sub-4-byte
+    inputs up-cast once to f32 (monotone, injective — ordering, ties, and
+    the cast-back values all exact)."""
+    R, cols = x.shape
+    numels = numels.astype(jnp.int32)
+    if (k > _LANE or k > cols
+            or 8 * _round_up(cols, _LANE) * max(x.dtype.itemsize, 4)
+            > _TOPK_VMEM_BYTES):
+        return select_pack_rows_reference(x, numels, k)
+    if x.dtype.itemsize < 4:
+        s, v, i = select_pack_rows(x.astype(jnp.float32), numels, k)
+        return s.astype(x.dtype), v.astype(x.dtype), i
+    rpad = (-R) % _SUBLANE
+    cpad = (-cols) % _LANE
+    if rpad or cpad:
+        # value pad is 0, masked to importance -1 by the padded numels
+        x = jnp.pad(x, ((0, rpad), (0, cpad)))
+    if rpad:
+        numels = jnp.pad(numels, (0, rpad))
+    R8, colsp = R + rpad, cols + cpad
+    spec_x = pl.BlockSpec((_SUBLANE, colsp), lambda r: (r, 0),
+                          memory_space=pltpu.VMEM)
+    spec_n = pl.BlockSpec((_SUBLANE, 1), lambda r: (r, 0),
+                          memory_space=pltpu.VMEM)
+    spec_o = pl.BlockSpec((_SUBLANE, _LANE), lambda r: (r, 0),
+                          memory_space=pltpu.VMEM)
+    s, v, i = pl.pallas_call(
+        functools.partial(_select_pack_kernel, k=k, cols=colsp),
+        grid=(R8 // _SUBLANE,),
+        out_shape=(jax.ShapeDtypeStruct((R8, _LANE), x.dtype),
+                   jax.ShapeDtypeStruct((R8, _LANE), x.dtype),
+                   jax.ShapeDtypeStruct((R8, _LANE), jnp.int32)),
+        in_specs=[spec_x, spec_n],
+        out_specs=(spec_o, spec_o, spec_o),
+        interpret=_interpret(),
+    )(x, numels.reshape(-1, 1))
+    return s[:R, :k], v[:R, :k], i[:R, :k]
 
 
 # ------------------------------------------------------------------ #

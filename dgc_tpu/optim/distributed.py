@@ -110,14 +110,27 @@ class DistributedOptimizer:
     # flat-buffer path (see dgc_tpu.compression.flat)                    #
     # ------------------------------------------------------------------ #
 
-    def make_flat(self, params):
+    def make_flat(self, params, plan=None):
         """Build the (ParamLayout, engine) pair for the fused flat-buffer
         pipeline. Compressed names are the compressor's initialized
         attributes (the dim>1 selection, reference train.py:136-140).
-        Call again after ``warmup_compress_ratio`` changes the ratio."""
+        Call again after ``warmup_compress_ratio`` changes the ratio.
+
+        ``plan`` — optional per-bucket exchange plan
+        (``compression.planner``); a ``Plan`` instance is re-fit to the
+        rebuilt geometry via ``Plan.replan``, so warmup rebuilds keep the
+        planner's fabric/cost context without the caller re-planning by
+        hand."""
         from dgc_tpu.compression.flat import ParamLayout
         layout = ParamLayout.for_compressor(params, self.compressor)
-        engine = self.compressor.make_flat_exchange(layout)
+        if plan is not None and hasattr(plan, "replan"):
+            # re-fit to THIS layout's bucket geometry (ratio-dependent):
+            # same fabric/cost/candidates, fresh payload sizes. A probe
+            # engine supplies the buckets — host-side numpy bookkeeping
+            # only, nothing is traced or compiled.
+            probe = self.compressor.make_flat_exchange(layout)
+            plan = plan.replan(probe)
+        engine = self.compressor.make_flat_exchange(layout, plan=plan)
         return layout, engine
 
     def update_flat(self, flat_grads, opt_state, flat_params, mem_state,

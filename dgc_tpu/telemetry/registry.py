@@ -94,6 +94,14 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
                "per-worker sparse wire bytes per step", better="lower"),
     MetricSpec("payload_elems", "scalar",
                "per-worker transmitted elements per step", better="lower"),
+    MetricSpec("ici_ratio", "scalar",
+               "modeled dense/DGC exchange-time ratio on the v5e-8 ICI "
+               "fabric (bench.py ici_v5e8.ratio)", better="higher"),
+    MetricSpec("ici_planned_ratio", "scalar",
+               "dense/planned exchange-time ratio on the v5e-8 ICI fabric "
+               "under the exchange planner (bench.py "
+               "planned.ici_v5e8.ratio) — the never-lose gate: the "
+               "planner must keep this >= ~1.0", better="higher"),
 )
 
 

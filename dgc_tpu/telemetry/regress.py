@@ -14,8 +14,9 @@ Either side may be:
   ``BENCH_r*.json`` wrapper around it (``{"parsed": {...}}``).
 
 Only the metrics present on BOTH sides are compared, each by its declared
-direction in :data:`dgc_tpu.telemetry.registry.RUN_METRICS` ("lower" is
-better for all of them today). A metric regresses when the new value is
+direction in :data:`dgc_tpu.telemetry.registry.RUN_METRICS` ("lower" for
+the time/volume metrics, "higher" for the fabric-regime speedup ratios
+``ici_ratio``/``ici_planned_ratio``). A metric regresses when the new value is
 worse than baseline by more than ``tol`` (relative). Improvements always
 pass.
 
@@ -54,6 +55,16 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
     for k in ("overhead_ms", "step_time_ms", "wire_bytes", "payload_elems"):
         if isinstance(obj.get(k), (int, float)):
             out[k] = float(obj[k])
+    # nested fabric-regime ratios (higher is better; see registry)
+    ici = obj.get("ici_v5e8")
+    if isinstance(ici, dict) and isinstance(ici.get("ratio"), (int, float)):
+        out["ici_ratio"] = float(ici["ratio"])
+    planned = obj.get("planned")
+    if isinstance(planned, dict):
+        pici = planned.get("ici_v5e8")
+        if isinstance(pici, dict) and isinstance(pici.get("ratio"),
+                                                 (int, float)):
+            out["ici_planned_ratio"] = float(pici["ratio"])
     return out
 
 

@@ -50,11 +50,18 @@ class FlatSetup(NamedTuple):
     engine: Any          # compressor flat-exchange engine
 
 
-def make_flat_setup(variables, dist_opt: DistributedOptimizer) -> FlatSetup:
+def make_flat_setup(variables, dist_opt: DistributedOptimizer,
+                    plan=None) -> FlatSetup:
     """Build layouts + engine from initialized model variables. Rebuild after
-    a warm-up compress-ratio change (the engine holds ratio-derived attrs)."""
+    a warm-up compress-ratio change (the engine holds ratio-derived attrs).
+
+    ``plan`` — optional per-bucket exchange plan
+    (``dgc_tpu.compression.planner``); a ``Plan`` is re-fit to the fresh
+    bucket geometry on every rebuild, so the warmup loop can pass the
+    same object each time and only recompiles when ``plan.key()``
+    actually changes."""
     from dgc_tpu.compression.flat import ParamLayout
-    layout, engine = dist_opt.make_flat(variables["params"])
+    layout, engine = dist_opt.make_flat(variables["params"], plan=plan)
     stats_layout = ParamLayout(variables.get("batch_stats", {}))
     return FlatSetup(layout, stats_layout, engine)
 

@@ -11,7 +11,13 @@ should agree within a small factor. Results feed docs/RESULTS.md.
 
 Run (parent self-spawns the two workers)::
 
-    python scripts/measure_exchange.py [--iters 5] [--big]
+    python scripts/measure_exchange.py [--iters 5] [--big] \\
+        [--fabric-out runs/fabric.json]
+
+``--fabric-out`` additionally writes the measured per-geometry latencies
+plus a fitted ``alpha + bytes/bw`` link model as a schema-versioned
+``fabric.json`` — the exchange planner's measured-fabric input
+(``dgc_tpu.compression.planner.load_fabric``).
 
 ``--big`` adds the VGG-16-BN geometry (138M params — ~4.5 GB of host
 buffers; off by default).
@@ -177,6 +183,37 @@ def parent(args):
         with open(args.json, "w") as fh:
             json.dump(result, fh, indent=1)
         print(f"wrote {args.json}", file=sys.stderr)
+    if args.fabric_out:
+        # schema-versioned fabric model for the exchange planner
+        # (dgc_tpu.compression.planner.load_fabric): the per-geometry
+        # measured latencies plus a fitted alpha/beta link model over
+        # every (bytes, ms) point — dense psums and sparse gathers
+        # together, so the intercept captures the per-collective launch
+        # latency and the slope the usable bandwidth
+        from dgc_tpu.compression.planner import (FABRIC_SCHEMA,
+                                                 FABRIC_VERSION,
+                                                 fit_link_model)
+        Wk = result["workers"]
+        pts = []
+        for r in result["rows"]:
+            pts.append((2 * 4 * r["P"] * (Wk - 1) / Wk, r["dense_ms"]))
+            pts.append(((Wk - 1) * r["K"] * 8, r["sparse_ms"]))
+        alpha_ms, gbps = fit_link_model(pts)
+        fabric = {
+            "schema": FABRIC_SCHEMA, "version": FABRIC_VERSION,
+            "name": f"measured-{Wk}w-gloo",
+            "workers": Wk,
+            "rows": result["rows"],
+            "fit": {"alpha_ms": round(alpha_ms, 6),
+                    "gbps": round(gbps, 6)},
+        }
+        d = os.path.dirname(os.path.abspath(args.fabric_out))
+        os.makedirs(d, exist_ok=True)
+        with open(args.fabric_out, "w") as fh:
+            json.dump(fabric, fh, indent=1)
+        print(f"wrote {args.fabric_out} "
+              f"(alpha={fabric['fit']['alpha_ms']} ms, "
+              f"gbps={fabric['fit']['gbps']})", file=sys.stderr)
     if args.telemetry_out:
         # the measured table as a telemetry run: one event record per
         # geometry, self-describing header — readable with
@@ -198,6 +235,12 @@ def main():
     ap.add_argument("--big", action="store_true",
                     help="include the 138M-param VGG geometry")
     ap.add_argument("--json", default=None, help="also dump raw JSON")
+    ap.add_argument("--fabric-out", default=None,
+                    help="write a schema-versioned fabric model (e.g. "
+                         "runs/fabric.json) for the exchange planner "
+                         "(dgc_tpu.compression.planner); the planner "
+                         "falls back to the built-in modeled fabrics "
+                         "when absent")
     ap.add_argument("--telemetry-out", default=None,
                     help="also log the measurements through the telemetry "
                          "sink (JSONL)")

@@ -37,6 +37,12 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.p
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "ELASTIC_SMOKE=ok" || { echo "ELASTIC_SMOKE=FAIL"; rc=1; }
+# planner smoke (docs/PLANNER.md): cost-model decision boundaries, plan
+# key stability / replan-on-ratio-change, fabric.json round-trip, and
+# the fused select/pack kernel's bitwise parity against the unfused path
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "PLANNER_SMOKE=ok" || { echo "PLANNER_SMOKE=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite — nonzero on any un-allowlisted finding
 # or broken step invariant (one sparse exchange, telemetry compiles away,

@@ -1560,3 +1560,88 @@ def test_3d_seg_top2_kernel_selection_path(monkeypatch, state_dtype):
     exact = np.argsort(-np.abs(vec[:numel]))[:count]
     recall = len(set(exact.tolist()) & set(idx[real].tolist())) / count
     assert recall >= 0.93 if state_dtype else recall >= 0.95, recall
+
+
+@pytest.mark.parametrize("sparse_regime", ["fp32", "int8_packed"])
+def test_flat_mixed_plan_matches_uniform_mixture(mesh8, sparse_regime):
+    """A mixed exchange plan (sparse bucket 0 + dense-planned bucket 1)
+    must produce, slab for slab, EXACTLY what the uniform engines
+    produce: bucket 0's output and memory match the uniform sparse
+    engine, bucket 1's and the dense tail's match the all-dense plan —
+    the planner changes the wire, never the math."""
+    from dgc_tpu.compression.flat import FlatDGCEngine, ParamLayout
+    from dgc_tpu.compression.planner import BUILTIN_FABRICS, Plan
+
+    rng = np.random.RandomState(0)
+    params = {
+        "big": {"kernel": jnp.asarray(rng.randn(600, 600), jnp.float32)},
+        "small": {"kernel": jnp.asarray(rng.randn(40, 50), jnp.float32)},
+        "bias": {"b": jnp.asarray(rng.randn(16), jnp.float32)},
+    }
+    named, _ = named_flatten(params)
+    compressed = [n for n, p in named.items() if p.ndim > 1]
+    layout = ParamLayout(params, compressed)
+    fab = BUILTIN_FABRICS["32x25GbE"]
+
+    def build(regimes):
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                    world_size=W)
+        engine = FlatDGCEngine(comp, layout, plan=Plan(regimes, fab, W))
+        return engine, _flat_exchange_fn(dist, engine, mesh8)
+
+    eng_mix, fn_mix = build((sparse_regime, "dense"))
+    eng_sp, fn_sp = build((sparse_regime, sparse_regime))
+    eng_dn, fn_dn = build(("dense", "dense"))
+    assert len(eng_mix.buckets) == 2
+    assert eng_mix.regimes == (sparse_regime, "dense")
+    assert eng_dn.plan.all_dense
+
+    g = rng.randn(W, layout.total).astype(np.float32)
+    # zero the structural-pad slots so flat buffers are well-formed
+    covered = np.zeros((layout.total,), bool)
+    for n in layout.names:
+        covered[layout.offsets[n]:layout.offsets[n] + layout.sizes[n]] = True
+    g[:, ~covered] = 0.0
+    fg = jnp.asarray(g)
+
+    def init_mem(engine):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+            engine.init_memory())
+
+    mems = [init_mem(e) for e in (eng_mix, eng_sp, eng_dn)]
+    b0, b1 = eng_mix.buckets
+    s0 = slice(b0.base, b0.base + b0.rows * b0.cols)
+    s1 = slice(b1.base, b1.base + b1.rows * b1.cols)
+    tail = slice(layout.t_compressed, layout.total)
+
+    for step in range(2):
+        key = jax.random.PRNGKey(step)
+        (o_mix, mems[0]), (o_sp, mems[1]), (o_dn, mems[2]) = (
+            fn(fg, m, key) for fn, m in zip((fn_mix, fn_sp, fn_dn), mems))
+        o_mix, o_sp, o_dn = (np.asarray(o[0]) for o in (o_mix, o_sp, o_dn))
+        # sparse-planned slab == uniform sparse engine, bitwise (the
+        # allgather wire carries identical payloads in both builds)
+        np.testing.assert_array_equal(o_mix[s0], o_sp[s0],
+                                      err_msg=f"step {step} bucket0")
+        # dense-planned slab + tail == all-dense plan to 1 ULP: the psum
+        # covers a differently-offset buffer (concat wire vs whole [P]),
+        # so the ring reduction may associate additions differently
+        np.testing.assert_allclose(o_mix[s1], o_dn[s1], rtol=2e-7,
+                                   atol=1e-7,
+                                   err_msg=f"step {step} bucket1")
+        np.testing.assert_allclose(o_mix[tail], o_dn[tail], rtol=2e-7,
+                                   atol=1e-7, err_msg=f"step {step} tail")
+        full_mix = _mem_full(eng_mix, mems[0], w=0)
+        full_sp = _mem_full(eng_sp, mems[1], w=0)
+        full_dn = _mem_full(eng_dn, mems[2], w=0)
+        for mk in ("momentums", "velocities"):
+            np.testing.assert_array_equal(
+                full_mix[mk][s0], full_sp[mk][s0],
+                err_msg=f"step {step} {mk} bucket0")
+            np.testing.assert_allclose(
+                full_mix[mk][s1], full_dn[mk][s1], rtol=2e-7, atol=1e-7,
+                err_msg=f"step {step} {mk} bucket1")

@@ -349,6 +349,78 @@ def test_topk_rows_k_exceeding_cols_raises_like_lax():
         topk_rows(x, 110)
 
 
+@pytest.mark.parametrize("shape,k", [((3, 40), 5), ((8, 128), 128),
+                                     ((5, 300), 7), ((12, 64), 64),
+                                     ((1, 16), 3)])
+def test_select_pack_rows_matches_reference(shape, k):
+    """The fused threshold->select->pack kernel must match the unfused
+    reference (masked |x| top_k + take_along_axis) bitwise: scores,
+    signed values, AND column order — the wire format depends on all
+    three."""
+    from dgc_tpu.ops.kernels import (select_pack_rows,
+                                     select_pack_rows_reference)
+
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    numels = jnp.asarray(
+        rng.randint(max(1, k), shape[1] + 1, shape[0]), jnp.int32)
+    s, v, i = select_pack_rows(x, numels, k)
+    s_ref, v_ref, i_ref = select_pack_rows_reference(x, numels, k)
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_select_pack_rows_ragged_rows_never_select_pad():
+    """Slots at/beyond a row's numel are structural zeros: even when every
+    real entry is tiny, the kernel must keep selecting real columns (the
+    masked importance is -1 there, below any |real| value >= 0)."""
+    from dgc_tpu.ops.kernels import select_pack_rows
+
+    x = jnp.full((4, 24), 1e-30, jnp.float32)
+    numels = jnp.asarray([5, 24, 1, 8], jnp.int32)
+    k = 4
+    s, v, i = select_pack_rows(x, numels, k)
+    i = np.asarray(i)
+    numels_np = np.asarray(numels)
+    for r in range(4):
+        kr = min(k, int(numels_np[r]))
+        assert (i[r, :kr] < numels_np[r]).all()
+
+
+def test_select_pack_rows_bf16_values():
+    """bf16 inputs recurse through the f32 path; returned signed values
+    keep the input dtype and equal the gathered originals."""
+    from dgc_tpu.ops.kernels import (select_pack_rows,
+                                     select_pack_rows_reference)
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(6, 48), jnp.bfloat16)
+    numels = jnp.full((6,), 48, jnp.int32)
+    s, v, i = select_pack_rows(x, numels, 9)
+    s_ref, v_ref, i_ref = select_pack_rows_reference(x, numels, 9)
+    assert v.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+    np.testing.assert_array_equal(np.asarray(v.astype(jnp.float32)),
+                                  np.asarray(v_ref.astype(jnp.float32)))
+
+
+def test_select_pack_rows_delegates_large():
+    """Shapes past the VMEM budget or k > lane width must fall back to the
+    reference path and stay exact."""
+    from dgc_tpu.ops.kernels import (select_pack_rows,
+                                     select_pack_rows_reference)
+
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(2, 2048), jnp.float32)
+    numels = jnp.asarray([2048, 1500], jnp.int32)
+    s, v, i = select_pack_rows(x, numels, 200)    # k > 128 lane width
+    s_ref, v_ref, i_ref = select_pack_rows_reference(x, numels, 200)
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_seg_top2_kernel_matches_reference(dtype):
     """seg_top2_candidates (interpret mode on CPU) == seg_top2_reference
