@@ -272,6 +272,54 @@ def main():
         }))
         return
 
+    if os.environ.get("DGC_FLEET_BENCH", "") == "1":
+        # fleet-dispersion baseline (ISSUE 10): run the fleet build of
+        # the step with real host prep-interval stamps (previous dispatch
+        # return -> this dispatch start, matching train.py) and report
+        # the median cross-worker dispersion scalars; regress.py gates
+        # worker_skew / straggler_gap (lower-is-better) against this
+        # artifact's "fleet" block.
+        from dgc_tpu.telemetry import fleet as fleet_mod
+        dist = DistributedOptimizer(
+            dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp,
+            world_size=W)
+        setup = make_flat_setup(v, dist)
+        state = shard_state(make_flat_state(v, dist, setup, W), mesh,
+                            dist_opt=dist)
+        step = build_train_step(model.apply, dist, mesh, donate=False,
+                                flat=setup, telemetry=True, fleet=True)
+        steps = int(os.environ.get("DGC_FLEET_STEPS", "30"))
+        key = jax.random.PRNGKey(0)
+        prev = None
+        fleet_rows = []
+        for i in range(steps):
+            now = time.perf_counter()
+            dt_ms = (now - prev) * 1e3 if prev is not None else 0.0
+            state, metrics = step(
+                state, images, labels, jax.random.fold_in(key, i),
+                fleet_mod.make_clock(dt_ms, mesh, W))
+            prev = time.perf_counter()
+            fleet_rows.append(metrics["fleet"])
+        # convert after the loop so readbacks don't stall the dispatches
+        skews = [float(r["worker_skew"]) for r in fleet_rows[1:]]
+        gaps = [float(r["straggler_gap"]) for r in fleet_rows[1:]]
+        skew_med = statistics.median(skews)
+        gap_med = statistics.median(gaps)
+        print(f"fleet dispersion over {steps} steps: worker_skew "
+              f"median {skew_med:.4g} | straggler_gap median "
+              f"{gap_med:.4g} ms", file=sys.stderr)
+        print(json.dumps({
+            "metric": "fleet_dispersion_resnet20_dgc0.001",
+            "value": round(skew_med, 6),
+            "unit": "relative",
+            "fleet": {
+                "worker_skew": round(skew_med, 6),
+                "straggler_gap": round(gap_med, 4),
+                "steps": steps,
+            },
+        }))
+        return
+
     dgc_run, dgc_setup = prepare(DistributedOptimizer(
         dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), comp, world_size=W))
     dense_run, _ = prepare(DistributedOptimizer(

@@ -1,0 +1,26 @@
+"""Fleet observability knob (docs/TELEMETRY.md §Fleet monitoring): append
+AFTER configs/telemetry.py to turn the cross-worker dispersion taps on:
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/telemetry.py configs/fleet.py
+
+Every record then carries the per-worker fleet columns (w_clock /
+w_grad_norm / w_residual_mass / w_sent_ratio + straggler/skew scalars) and
+EVERY process writes its own ``telemetry/host<i>/`` sink shard. Watch the
+run live with::
+
+    python -m dgc_tpu.telemetry.monitor <save_path>
+
+Costs at most ONE extra packed collective per step (the telemetry pmean
+becomes a packed all_gather) and zero host syncs — contract-pinned in
+``python -m dgc_tpu.analysis --gate``.
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+if "telemetry" not in configs.train:
+    configs.train.telemetry = Config()
+    configs.train.telemetry.enabled = True
+    configs.train.telemetry.every = 1
+    configs.train.telemetry.rotate_mb = 64
+configs.train.telemetry.fleet = True

@@ -10,6 +10,10 @@ tests exercise:
 * **telemetry rides free**: telemetry=True adds exactly ONE packed
   all-reduce (taps.pmean_stats); telemetry=False is byte-identical to a
   build that never mentioned telemetry.
+* **fleet taps cost one gather**: fleet=True replaces the telemetry
+  pmean with ONE packed all-gather (net vs the plain build: +1
+  all-gather, +0 all-reduce); fleet=False is byte-identical to a
+  telemetry build that never mentioned fleet.
 * **donation aliases**: donate=True materializes input_output_alias for
   the state buffers (param 0 included); donate=False aliases nothing.
 * **fused-apply epilogue is barrier-free**: kernels.payload_apply_bits
@@ -200,6 +204,35 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         identical_to=_step_contract("telemetry-never-built", state,
                                     step_default, inputs))
     run(off.name, off.check)
+
+    # fleet dispersion taps (ISSUE 10): the fleet build REPLACES the
+    # telemetry pmean with one packed all_gather carrying the per-worker
+    # lanes, so against the PLAIN build the whole feature costs exactly
+    # one extra collective (+1 all-gather, +0 all-reduce) — the "at most
+    # one packed collective, zero host syncs" pin
+    from dgc_tpu.parallel import make_mesh as _make_mesh
+    from dgc_tpu.telemetry import fleet as _fleet
+    _, step_fleet, _, _ = build_fixture(mesh, donate=False, telemetry=True,
+                                        fleet=True)
+    clock = _fleet.make_clock(0.0, mesh or _make_mesh(8), 8)
+    images_f, labels_f, key_f = inputs
+    fon = Contract(
+        "fleet-on-one-packed-gather", step_fleet,
+        args=(state, images_f, labels_f, key_f, clock)).expects(
+        collectives_delta=(plain, {"all-gather": 1, "all-reduce": 0}),
+        no_f64=True)
+    run(fon.name, fon.check)
+
+    # fleet=False must be byte-identical to a telemetry build that never
+    # mentioned fleet, with zero fleet code lowered into it
+    _, step_foff, _, _ = build_fixture(mesh, donate=False, telemetry=True,
+                                       fleet=False)
+    foff = _step_contract(
+        "fleet-off-compiles-away", state, step_foff, inputs,
+        forbid_substrings=["telemetry/fleet"],
+        identical_to=_step_contract("fleet-never-built", state,
+                                    step_telem, inputs))
+    run(foff.name, foff.check)
 
     # guards=None must be byte-identical to a build that never mentioned
     # guards (the resilience layer is Python-static), and the plain

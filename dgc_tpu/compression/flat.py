@@ -2188,44 +2188,53 @@ class FlatDGCEngine:
             # residual above and is the norm directly. Mixed plans with
             # int8 EF count only the deferred (non-i8) slots.
             if m is None:
-                tx_energy = None
+                tx_energy = tx_abs = None
             elif int8_ef and self._i8_slot_mask is not None:
-                tx_energy = jnp.sum(jnp.where(
-                    jnp.asarray(self._i8_slot_mask), 0.0,
-                    values.astype(jnp.float32)) ** 2)
+                keep_tx = jnp.where(jnp.asarray(self._i8_slot_mask), 0.0,
+                                    values.astype(jnp.float32))
+                tx_energy = jnp.sum(keep_tx ** 2)
+                tx_abs = jnp.sum(jnp.abs(keep_tx))
             elif int8_ef:
-                tx_energy = None
+                tx_energy = tx_abs = None
             else:
-                tx_energy = jnp.sum(values.astype(jnp.float32) ** 2)
+                vf = values.astype(jnp.float32)
+                tx_energy = jnp.sum(vf ** 2)
+                tx_abs = jnp.sum(jnp.abs(vf))
             return out, mem, self._telemetry_stats(
                 taps, grad_norm, clip_delta, mc, md, vc, sel_stats,
-                tx_energy=tx_energy)
+                tx_energy=tx_energy, tx_abs=tx_abs)
         return out, mem
 
     def _telemetry_stats(self, taps, grad_norm, clip_delta, mc, md, vc,
-                         sel, tx_energy=None):
+                         sel, tx_energy=None, tx_abs=None):
         """Assemble the STEP_METRICS pytree (see telemetry.taps). ``sel``
         is sparsify's stats_out dict, or None on the dense-only paths
-        (zero payload, zero wire). ``tx_energy`` — sum of squared
-        transmitted values for the deferred-masking residual identity;
-        None means vc already IS the residual (dense path / int8 EF)."""
+        (zero payload, zero wire). ``tx_energy`` / ``tx_abs`` — sum of
+        squared / absolute transmitted values for the deferred-masking
+        residual identity; None means vc already IS the residual (dense
+        path / int8 EF). The abs identity is exact for the same reason the
+        energy one is: under deferred masking the transmitted slots of vc
+        hold exactly the transmitted values, and masking zeroes them."""
         if sel is None:
             sel = taps.empty_bucket_stats(len(self.buckets))
             wire = 0.0
         else:
             wire = float(self.wire_bytes_per_worker())
         if mc is None and md is None and vc is None:
-            mom = res = jnp.zeros((), jnp.float32)
+            mom = res = mass = jnp.zeros((), jnp.float32)
         else:
             mom = jnp.sqrt(taps.l2(mc) ** 2 + taps.l2(md) ** 2)
             if tx_energy is None:
                 res = taps.l2(vc)
+                mass = taps.l1(vc)
             else:
                 res = jnp.sqrt(jnp.maximum(
                     jnp.sum(vc.astype(jnp.float32) ** 2) - tx_energy, 0.0))
+                mass = jnp.maximum(taps.l1(vc) - tx_abs, 0.0)
         return taps.assemble_step_stats(
             grad_norm=grad_norm, momentum_norm=mom, residual_norm=res,
-            clip_delta=clip_delta, payload_elems=sel["payload_elems"],
+            residual_mass=mass, clip_delta=clip_delta,
+            payload_elems=sel["payload_elems"],
             wire_bytes=jnp.asarray(wire, jnp.float32),
             selected_frac=sel["selected_frac"], threshold=sel["threshold"])
 
@@ -2319,6 +2328,7 @@ class FlatDenseExchange:
                 grad_norm=taps.l2(flat_grad),
                 momentum_norm=jnp.zeros((), jnp.float32),
                 residual_norm=jnp.zeros((), jnp.float32),
+                residual_mass=jnp.zeros((), jnp.float32),
                 clip_delta=jnp.zeros((), jnp.float32),
                 wire_bytes=jnp.zeros((), jnp.float32),
                 **taps.empty_bucket_stats(0))

@@ -20,6 +20,10 @@ Comma-separated tokens, each ``kind[@step][:key=val]*``:
   preemption drill for the kill-and-resume multiprocess test).
 * ``init_fail@N`` — the first N ``jax.distributed.initialize`` attempts
   raise (exercises the bounded retry in ``parallel.multihost``).
+* ``slow[:ms=M]`` — host-side ``sleep(M ms)`` before every step dispatch
+  on the armed process (set the env on ONE worker to make it the
+  deterministic straggler the fleet taps must name — the sleep stretches
+  that process's dispatch interval, never touching the traced program).
 
 With ``DGC_FAULTS`` unset every hook is an identity at trace time: zero
 ops, zero HLO difference (the guards-off compile-away contract runs with
@@ -32,7 +36,8 @@ import signal
 from typing import Dict, NamedTuple, Optional
 
 __all__ = ["FaultPlan", "plan", "armed", "inject_nan_grads", "corrupt_wire",
-           "corrupt_indices", "maybe_kill", "should_fail_init"]
+           "corrupt_indices", "maybe_kill", "maybe_slow",
+           "should_fail_init"]
 
 ENV = "DGC_FAULTS"
 
@@ -43,13 +48,14 @@ class FaultPlan(NamedTuple):
     init_failures: int = 0
     bitflip: Optional[Dict[str, int]] = None
     badidx: Optional[Dict[str, int]] = None
+    slow_ms: Optional[int] = None
 
 
 def plan(spec: Optional[str] = None) -> FaultPlan:
     """Parse the fault plan from ``spec`` or the ``DGC_FAULTS`` env var."""
     if spec is None:
         spec = os.environ.get(ENV, "")
-    nan_step = kill_step = None
+    nan_step = kill_step = slow_ms = None
     init_failures = 0
     bitflip = badidx = None
     for tok in filter(None, (t.strip() for t in spec.split(","))):
@@ -71,9 +77,12 @@ def plan(spec: Optional[str] = None) -> FaultPlan:
         elif head == "badidx":
             badidx = {"elem": params.get("elem", 0),
                       "set": params.get("set", -1)}
+        elif head == "slow":
+            slow_ms = params.get("ms", 100)
         else:
             raise ValueError(f"unknown fault token {tok!r} in {ENV}")
-    return FaultPlan(nan_step, kill_step, init_failures, bitflip, badidx)
+    return FaultPlan(nan_step, kill_step, init_failures, bitflip, badidx,
+                     slow_ms)
 
 
 def armed() -> bool:
@@ -147,6 +156,16 @@ def maybe_kill(step: int) -> None:
     p = plan()
     if p.kill_step is not None and int(step) == p.kill_step:
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_slow() -> None:
+    """Host-side sleep before a step dispatch on the armed process (the
+    deterministic straggler drill: identical traced program everywhere;
+    only THIS process's dispatch interval stretches)."""
+    p = plan()
+    if p.slow_ms is not None:
+        import time
+        time.sleep(p.slow_ms / 1000.0)
 
 
 def should_fail_init(attempt: int) -> bool:

@@ -1,0 +1,423 @@
+"""Live fleet run monitor (docs/TELEMETRY.md §Fleet monitoring).
+
+Point it at a run directory (or a single sink file) and it tails the
+telemetry shards through the tolerant reader, merges the fleet view
+(:mod:`dgc_tpu.telemetry.fleet`), and serves two read-only projections:
+
+* ``GET /metrics`` — OpenMetrics / Prometheus text exposition
+  (``dgc_``-prefixed gauges, per-worker series labeled ``worker="i"``,
+  terminated by ``# EOF`` per the OpenMetrics spec), and
+* a terminal status view — step / step rate / loss / compression ratio /
+  guard counters / per-worker straggler table / desync verdict / the last
+  run event and the last ``scripts/supervise.py`` relaunch event.
+
+::
+
+    python -m dgc_tpu.telemetry.monitor runs/exp           # serve + tail
+    python -m dgc_tpu.telemetry.monitor runs/exp --once    # render once
+    python -m dgc_tpu.telemetry.monitor runs/exp --once --openmetrics
+
+The monitor is a pure reader: plain file tailing + numpy, no jax, no
+writes into the run directory, safe to run beside (or long after) the
+trainer. Live-writer torn lines are skipped-with-count by the tolerant
+reader and the count is surfaced, never silently averaged over.
+"""
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dgc_tpu.telemetry import fleet as _fleet
+
+__all__ = ["collect", "render_openmetrics", "render_status", "serve",
+           "supervise_events_path", "read_supervise_events"]
+
+#: default event-stream filename scripts/supervise.py writes under the run
+SUPERVISE_EVENTS = "supervise_events.jsonl"
+
+#: OpenMetrics names for the per-worker fleet columns
+_WORKER_GAUGES = {
+    "w_clock": ("dgc_worker_clock_ms",
+                "host-stamped step prep interval per worker (ms)"),
+    "w_grad_norm": ("dgc_worker_grad_norm",
+                    "per-worker L2 norm of the local flat gradient"),
+    "w_residual_mass": ("dgc_worker_residual_mass",
+                        "per-worker L1 mass of the error-feedback residual"),
+    "w_sent_ratio": ("dgc_worker_sent_ratio",
+                     "per-worker transmitted / total model elements"),
+}
+
+#: OpenMetrics names for scalar record columns (latest step's value)
+_SCALAR_GAUGES = {
+    "loss": ("dgc_loss", "training loss at the latest recorded step"),
+    "grad_norm": ("dgc_grad_norm", "cohort-mean gradient L2 norm"),
+    "residual_mass": ("dgc_residual_mass",
+                      "cohort-mean residual L1 mass"),
+    "straggler": ("dgc_straggler",
+                  "argmax worker index of the prep-interval column"),
+    "straggler_gap": ("dgc_straggler_gap_ms",
+                      "max-min prep interval across workers (ms)"),
+    "worker_skew": ("dgc_worker_skew",
+                    "max relative cross-worker dispersion"),
+    "skipped_steps": ("dgc_guard_skipped_steps",
+                      "cumulative guard-skipped updates"),
+    "nonfinite_rate": ("dgc_guard_nonfinite_rate",
+                       "fraction of guarded steps with nonfinite values"),
+    "checksum_failures": ("dgc_guard_checksum_failures",
+                          "cumulative payload-checksum mismatches"),
+}
+
+
+# --------------------------------------------------------------------- #
+# supervise event stream                                                 #
+# --------------------------------------------------------------------- #
+
+def supervise_events_path(run: str) -> Optional[str]:
+    """First existing supervise event stream near the run: the run dir
+    itself, then its parent (``--watch <run>/checkpoints`` makes
+    scripts/supervise.py default its stream next to the watch dir)."""
+    if os.path.isfile(run):
+        run = os.path.dirname(os.path.abspath(run))
+    for d in (run, os.path.dirname(os.path.abspath(run))):
+        p = os.path.join(d, SUPERVISE_EVENTS)
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def read_supervise_events(run: str) -> List[Dict]:
+    """Tolerantly read the supervisor's JSONL event stream (torn tail
+    lines from a live writer are dropped)."""
+    path = supervise_events_path(run)
+    if path is None:
+        return []
+    out: List[Dict] = []
+    with open(path) as fh:
+        for ln in fh:
+            if not ln.strip():
+                continue
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+# --------------------------------------------------------------------- #
+# snapshot                                                               #
+# --------------------------------------------------------------------- #
+
+def collect(run: str, *, rate_window: int = 50) -> Dict:
+    """One monitor snapshot of a run: latest record, derived rates, fleet
+    summary, straggler table, and the trailing events. Pure read."""
+    view = _fleet.load_view(run)
+    steps = view.steps
+    last = steps[-1] if steps else {}
+    static = view.header.get("static", {})
+    snap: Dict = {
+        "run": run,
+        "t_collect": time.time(),
+        "step": int(last.get("step", 0)),
+        "num_steps": len(steps),
+        "world": view.world,
+        "num_hosts": len(view.hosts),
+        "skipped_lines": view.skipped,
+        "static": static,
+        "last": last,
+        "summary": _fleet.fleet_summary(view),
+        "straggler_table": _fleet.straggler_table(view),
+    }
+    # step rate from the sink's host stamps over the trailing window
+    tail = [r for r in steps[-rate_window:]
+            if isinstance(r.get("t_host"), (int, float))]
+    if len(tail) >= 2:
+        span = float(tail[-1]["t_host"]) - float(tail[0]["t_host"])
+        if span > 0:
+            snap["steps_per_s"] = round((len(tail) - 1) / span, 3)
+    # compression ratio: model elements / transmitted elements per worker
+    total = static.get("num_params")
+    payload = None
+    pvals = [float(r["payload_elems"]) for r in steps[-rate_window:]
+             if isinstance(r.get("payload_elems"), (int, float))]
+    if pvals:
+        payload = float(np.mean(pvals))
+    elif static.get("payload_elems"):
+        payload = float(static["payload_elems"])
+    if total and payload:
+        snap["compression_ratio"] = round(float(total) / payload, 2)
+    if view.events:
+        snap["last_event"] = view.events[-1]
+    sup = read_supervise_events(run)
+    if sup:
+        snap["supervise_launches"] = max(
+            (int(e.get("launches", 0)) for e in sup), default=0)
+        snap["last_supervise"] = sup[-1]
+    return snap
+
+
+# --------------------------------------------------------------------- #
+# renderers                                                              #
+# --------------------------------------------------------------------- #
+
+def _fmt(v: float) -> str:
+    # OpenMetrics float formatting: plain repr, no exponent surprises
+    f = float(v)
+    return repr(int(f)) if f.is_integer() and abs(f) < 2**53 else repr(f)
+
+
+def render_openmetrics(snap: Dict) -> str:
+    """OpenMetrics text exposition for one snapshot — gauges only, each
+    with HELP/TYPE, per-worker series labeled, ``# EOF`` terminated."""
+    lines: List[str] = []
+
+    def gauge(name, help_, samples):
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for labels, value in samples:
+            lines.append(f"{name}{labels} {_fmt(value)}")
+
+    gauge("dgc_step", "latest recorded step (sample-count cursor)",
+          [("", snap.get("step", 0))])
+    gauge("dgc_records", "step records merged across host shards",
+          [("", snap.get("num_steps", 0))])
+    gauge("dgc_world", "cohort world size", [("", snap.get("world", 0))])
+    gauge("dgc_hosts", "host shards merged",
+          [("", snap.get("num_hosts", 0))])
+    gauge("dgc_skipped_lines",
+          "torn JSONL lines skipped by the tolerant reader",
+          [("", snap.get("skipped_lines", 0))])
+    if "steps_per_s" in snap:
+        gauge("dgc_steps_per_second",
+              "record rate over the trailing window",
+              [("", snap["steps_per_s"])])
+    if "compression_ratio" in snap:
+        gauge("dgc_compression_ratio",
+              "model elements / transmitted elements per worker",
+              [("", snap["compression_ratio"])])
+
+    last = snap.get("last", {})
+    for key, (name, help_) in _SCALAR_GAUGES.items():
+        if isinstance(last.get(key), (int, float)):
+            gauge(name, help_, [("", last[key])])
+    for key, (name, help_) in _WORKER_GAUGES.items():
+        col = last.get(key)
+        if isinstance(col, list) and col:
+            gauge(name, help_,
+                  [(f'{{worker="{i}"}}', v) for i, v in enumerate(col)])
+
+    summary = snap.get("summary", {})
+    gauge("dgc_desync_alerts",
+          "desync detector alerts across monitored mass metrics",
+          [("", summary.get("desync_alerts", 0))])
+    if "supervise_launches" in snap:
+        gauge("dgc_supervise_launches",
+              "trainer launches recorded by the restart supervisor",
+              [("", snap["supervise_launches"])])
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def _event_line(e: Dict) -> str:
+    kind = e.get("event", "?")
+    extras = {k: e[k] for k in ("step", "epoch", "rc", "launches", "worker",
+                                "host", "reason") if k in e}
+    t = e.get("t", e.get("t_host"))
+    when = time.strftime("%H:%M:%S", time.localtime(t)) if t else "--"
+    kv = " ".join(f"{k}={v}" for k, v in extras.items())
+    return f"{kind} @{when}" + (f" ({kv})" if kv else "")
+
+
+def render_status(snap: Dict) -> str:
+    """Terminal status view for one snapshot."""
+    summary = snap.get("summary", {})
+    last = snap.get("last", {})
+    lines = [
+        f"== dgc fleet monitor == {snap['run']}",
+        "   step {step}  records {num_steps}  world {world}  "
+        "hosts {num_hosts}".format(**snap),
+    ]
+    row2 = []
+    if "steps_per_s" in snap:
+        row2.append(f"rate {snap['steps_per_s']}/s")
+    if isinstance(last.get("loss"), (int, float)):
+        row2.append(f"loss {last['loss']:.4g}")
+    if "compression_ratio" in snap:
+        row2.append(f"compression {snap['compression_ratio']}x")
+    if snap.get("skipped_lines"):
+        row2.append(f"torn-lines-skipped {snap['skipped_lines']}")
+    if row2:
+        lines.append("   " + "  ".join(row2))
+    guards = [f"{k}={last[k]:.4g}" for k in
+              ("skipped_steps", "nonfinite_rate", "checksum_failures")
+              if isinstance(last.get(k), (int, float))]
+    if guards:
+        lines.append("   guards: " + "  ".join(guards))
+
+    table = snap.get("straggler_table") or []
+    if table:
+        lines.append("   worker  mean_ms   max_ms  last_ms  share")
+        for r in table:
+            mark = "  <- straggler" if r is table[0] and len(table) > 1 \
+                else ""
+            lines.append(
+                f"   {r['worker']:>6}  {r['mean_ms']:>7.1f}  "
+                f"{r['max_ms']:>7.1f}  {r['last_ms']:>7.1f}  "
+                f"{r['share']:>5.2f}{mark}")
+        if "straggler_gap" in summary:
+            lines.append(
+                f"   straggler gap {summary['straggler_gap']:.1f}ms  "
+                f"worker skew {summary.get('worker_skew', 0.0):.3g}")
+    else:
+        lines.append("   (no fleet clock column — run without "
+                     "configs/fleet.py?)")
+
+    n_alerts = summary.get("desync_alerts", 0)
+    if n_alerts:
+        first = summary.get("desync_first", {})
+        lines.append(
+            f"   DESYNC: {n_alerts} alerts, workers "
+            f"{summary.get('desync_workers')} — first at step "
+            f"{first.get('step')} ({first.get('metric')}, deviation "
+            f"{first.get('deviation', 0.0):.2f} > band "
+            f"{first.get('band', 0.0):.2f})")
+    else:
+        lines.append("   desync: quiet")
+
+    if "last_event" in snap:
+        lines.append("   last run event:   "
+                     + _event_line(snap["last_event"]))
+    if "last_supervise" in snap:
+        lines.append("   last supervise:   "
+                     + _event_line(snap["last_supervise"])
+                     + f"  [launches={snap.get('supervise_launches', 0)}]")
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------- #
+# server                                                                 #
+# --------------------------------------------------------------------- #
+
+_OPENMETRICS_CT = ("application/openmetrics-text; version=1.0.0; "
+                   "charset=utf-8")
+
+
+class _Cache:
+    """Re-collect at most once per ``interval`` seconds; collection
+    errors (e.g. the run dir appearing late) are served as a 503 body
+    rather than killing the monitor."""
+
+    def __init__(self, run: str, interval: float):
+        self.run = run
+        self.interval = float(interval)
+        self._lock = threading.Lock()
+        self._snap: Optional[Dict] = None
+        self._err: Optional[str] = None
+        self._t = 0.0
+
+    def snapshot(self):
+        with self._lock:
+            now = time.monotonic()
+            if self._snap is None or now - self._t >= self.interval:
+                try:
+                    self._snap, self._err = collect(self.run), None
+                except (OSError, ValueError) as e:
+                    self._err = f"{type(e).__name__}: {e}"
+                self._t = now
+            return self._snap, self._err
+
+
+def _make_handler(cache: "_Cache"):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            snap, err = cache.snapshot()
+            if snap is None:
+                body, code, ct = (err or "no data") + "\n", 503, \
+                    "text/plain; charset=utf-8"
+            elif self.path.rstrip("/") in ("", "/status"):
+                body, code, ct = render_status(snap), 200, \
+                    "text/plain; charset=utf-8"
+            elif self.path == "/metrics":
+                body, code, ct = render_openmetrics(snap), 200, \
+                    _OPENMETRICS_CT
+            else:
+                body, code, ct = "not found\n", 404, \
+                    "text/plain; charset=utf-8"
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ct)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):   # quiet: status goes to the terminal
+            pass
+
+    return Handler
+
+
+def serve(run: str, *, port: int = 9100, interval: float = 5.0,
+          max_iterations: Optional[int] = None) -> int:
+    """Serve ``/metrics`` + ``/status`` and print the terminal view every
+    ``interval`` seconds until interrupted (``max_iterations`` bounds the
+    loop for tests)."""
+    cache = _Cache(run, interval=min(interval, 5.0))
+    server = ThreadingHTTPServer(("", port), _make_handler(cache))
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="dgc-monitor-http")
+    thread.start()
+    print(f"[monitor] serving /metrics + /status on "
+          f"http://0.0.0.0:{server.server_address[1]}  (ctrl-c to stop)",
+          flush=True)
+    n = 0
+    try:
+        while max_iterations is None or n < max_iterations:
+            snap, err = cache.snapshot()
+            print(render_status(snap) if snap is not None
+                  else f"[monitor] waiting for telemetry: {err}",
+                  flush=True)
+            n += 1
+            if max_iterations is not None and n >= max_iterations:
+                break
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+def _main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dgc_tpu.telemetry.monitor",
+        description="live fleet monitor over a telemetry run directory")
+    ap.add_argument("run", help="run dir (or telemetry dir / .jsonl file)")
+    ap.add_argument("--port", type=int, default=9100,
+                    help="OpenMetrics endpoint port (0 = ephemeral)")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="terminal refresh / re-read period, seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot to stdout and exit")
+    ap.add_argument("--openmetrics", action="store_true",
+                    help="with --once: print the /metrics exposition "
+                         "instead of the status view")
+    args = ap.parse_args(argv)
+    if args.once:
+        try:
+            snap = collect(args.run)
+        except (OSError, ValueError) as e:
+            print(f"[monitor] {type(e).__name__}: {e}")
+            return 1
+        print(render_openmetrics(snap) if args.openmetrics
+              else render_status(snap), end="")
+        return 0
+    return serve(args.run, port=args.port, interval=args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -12,9 +12,10 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 __all__ = [
     "SCHEMA", "SCHEMA_VERSION", "MetricSpec", "STEP_METRICS", "RUN_METRICS",
-    "GUARD_METRICS", "step_stat_names", "guard_stat_names", "spec_by_name",
-    "step_out_specs", "guard_out_specs", "make_header",
-    "validate_step_stats", "validate_guard_stats",
+    "GUARD_METRICS", "FLEET_METRICS", "step_stat_names", "guard_stat_names",
+    "fleet_stat_names", "spec_by_name", "step_out_specs", "guard_out_specs",
+    "fleet_out_specs", "make_header", "validate_step_stats",
+    "validate_guard_stats", "validate_fleet_stats",
 ]
 
 #: schema family tag written into every sink header
@@ -26,8 +27,9 @@ SCHEMA_VERSION = 1
 class MetricSpec(NamedTuple):
     """One metric column.
 
-    ``kind`` — "scalar" (one f32 per step) or "per_bucket" (one value per
-    size bucket of the flat engine, variable length across engine rebuilds).
+    ``kind`` — "scalar" (one f32 per step), "per_bucket" (one value per
+    size bucket of the flat engine, variable length across engine rebuilds),
+    or "per_worker" (one value per mesh worker, length = world size).
     ``better`` — regression direction for the gate: "lower", "higher", or
     "" for purely informational columns the gate never compares.
     """
@@ -46,6 +48,10 @@ STEP_METRICS: Tuple[MetricSpec, ...] = (
     MetricSpec("residual_norm", "scalar",
                "L2 norm of the untransmitted error-feedback residual after "
                "this step's selection"),
+    MetricSpec("residual_mass", "scalar",
+               "L1 mass (sum |v|) of the untransmitted error-feedback "
+               "residual — the additive per-worker quantity the elastic "
+               "reshard conserves, and the fleet desync detector's signal"),
     MetricSpec("clip_delta", "scalar",
                "relative gradient-norm reduction from clipping this step "
                "(0 when clipping is off or did not bind)"),
@@ -80,6 +86,38 @@ GUARD_METRICS: Tuple[MetricSpec, ...] = (
                "exchange (0 when the checksum is off)", better="lower"),
 )
 
+#: cross-worker dispersion stats emitted by the fleet taps
+#: (dgc_tpu.telemetry.fleet, ISSUE 10) under the record key "fleet".
+#: ADDITIVE to schema version 1, same doctrine as GUARD_METRICS: records
+#: carry these keys only when fleet taps are on, readers are key-generic,
+#: and the header lists them under "fleet_metrics" when present. The
+#: per_worker columns come out of ONE packed all_gather that *replaces*
+#: the telemetry pmean (means are computed locally from the gathered
+#: matrix), so the fleet build costs at most one extra collective over
+#: the plain step — contract-pinned in dgc_tpu.analysis.suite.
+FLEET_METRICS: Tuple[MetricSpec, ...] = (
+    MetricSpec("w_clock", "per_worker",
+               "host-stamped dispatch interval per worker (ms since that "
+               "process dispatched its previous step) — the step-time "
+               "proxy; comparable across hosts without clock sync"),
+    MetricSpec("w_grad_norm", "per_worker",
+               "per-worker L2 norm of the local flat gradient"),
+    MetricSpec("w_residual_mass", "per_worker",
+               "per-worker L1 mass of the error-feedback residual"),
+    MetricSpec("w_sent_ratio", "per_worker",
+               "per-worker transmitted elements / total model elements "
+               "(the sent-bits ratio)"),
+    MetricSpec("straggler", "scalar",
+               "argmax worker index of w_clock this step (the worker the "
+               "cohort waited on)"),
+    MetricSpec("straggler_gap", "scalar",
+               "max - min of w_clock (ms): how far the slowest worker "
+               "trails the fastest", better="lower"),
+    MetricSpec("worker_skew", "scalar",
+               "max over the monitored dimensions of the relative cohort "
+               "dispersion (max - min) / max(|mean|, eps)", better="lower"),
+)
+
 #: run-level summary keys the regression gate compares (step time and
 #: overhead come from bench records; wire volume from either source).
 RUN_METRICS: Tuple[MetricSpec, ...] = (
@@ -102,6 +140,12 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
                "under the exchange planner (bench.py "
                "planned.ici_v5e8.ratio) — the never-lose gate: the "
                "planner must keep this >= ~1.0", better="higher"),
+    MetricSpec("worker_skew", "scalar",
+               "median per-step relative cross-worker dispersion from the "
+               "fleet taps (bench.py fleet.worker_skew)", better="lower"),
+    MetricSpec("straggler_gap", "scalar",
+               "median per-step max-min dispatch-interval gap across "
+               "workers, ms (bench.py fleet.straggler_gap)", better="lower"),
 )
 
 
@@ -113,9 +157,13 @@ def guard_stat_names() -> Tuple[str, ...]:
     return tuple(s.name for s in GUARD_METRICS)
 
 
+def fleet_stat_names() -> Tuple[str, ...]:
+    return tuple(s.name for s in FLEET_METRICS)
+
+
 def spec_by_name() -> Dict[str, MetricSpec]:
     seen: Dict[str, MetricSpec] = {}
-    for s in STEP_METRICS + GUARD_METRICS + RUN_METRICS:
+    for s in STEP_METRICS + GUARD_METRICS + FLEET_METRICS + RUN_METRICS:
         seen.setdefault(s.name, s)
     return seen
 
@@ -132,6 +180,14 @@ def guard_out_specs(spec_fn):
     counters are replicated by construction (pure functions of psum'd /
     gathered data), so no pmean rides on them."""
     return {s.name: spec_fn() for s in GUARD_METRICS}
+
+
+def fleet_out_specs(spec_fn):
+    """Out-spec pytree for the step's fleet aux output. Every fleet stat
+    is replicated by construction: the per_worker columns come out of the
+    packed all_gather identically on every worker, and the derived
+    scalars are pure functions of them."""
+    return {s.name: spec_fn() for s in FLEET_METRICS}
 
 
 def validate_step_stats(stats: Dict) -> None:
@@ -152,11 +208,21 @@ def validate_guard_stats(stats: Dict) -> None:
             f"missing={sorted(want - got)} extra={sorted(got - want)}")
 
 
+def validate_fleet_stats(stats: Dict) -> None:
+    """Same drift check for the fleet-dispersion dict."""
+    got, want = set(stats), set(fleet_stat_names())
+    if got != want:
+        raise ValueError(
+            f"fleet stats drifted from the registry schema: "
+            f"missing={sorted(want - got)} extra={sorted(got - want)}")
+
+
 def make_header(static: Optional[Dict] = None,
-                guards: bool = False) -> Dict:
+                guards: bool = False, fleet: bool = False) -> Dict:
     """Versioned JSONL header row (first line of every sink file).
-    ``guards=True`` additionally lists the guard columns the records will
-    carry — an additive key, readers of version 1 ignore it safely."""
+    ``guards=True`` / ``fleet=True`` additionally list the guard / fleet
+    columns the records will carry — additive keys, readers of version 1
+    ignore them safely."""
     header = {
         "schema": SCHEMA,
         "version": SCHEMA_VERSION,
@@ -165,4 +231,6 @@ def make_header(static: Optional[Dict] = None,
     }
     if guards:
         header["guard_metrics"] = [s._asdict() for s in GUARD_METRICS]
+    if fleet:
+        header["fleet_metrics"] = [s._asdict() for s in FLEET_METRICS]
     return header

@@ -65,6 +65,12 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
         if isinstance(pici, dict) and isinstance(pici.get("ratio"),
                                                  (int, float)):
             out["ici_planned_ratio"] = float(pici["ratio"])
+    # fleet dispersion medians (lower is better; see registry)
+    flt = obj.get("fleet")
+    if isinstance(flt, dict):
+        for k in ("worker_skew", "straggler_gap"):
+            if isinstance(flt.get(k), (int, float)):
+                out[k] = float(flt[k])
     return out
 
 

@@ -35,8 +35,8 @@ import numpy as np
 
 from dgc_tpu.telemetry import registry
 
-__all__ = ["TelemetrySink", "SchemaMismatchError", "read_run", "summarize",
-           "to_csv"]
+__all__ = ["TelemetrySink", "SchemaMismatchError", "read_run",
+           "read_run_tolerant", "summarize", "to_csv"]
 
 _CLOSE = object()
 
@@ -68,10 +68,11 @@ class TelemetrySink:
 
     def __init__(self, path: str, static: Optional[Dict] = None,
                  rotate_bytes: int = 64 << 20, enabled: bool = True,
-                 guards: bool = False):
+                 guards: bool = False, fleet: bool = False):
         self.enabled = bool(enabled)
         self._static = dict(static or {})
         self._guards = bool(guards)
+        self._fleet = bool(fleet)
         self._rotate_bytes = int(rotate_bytes)
         self._rotations = 0
         self._dropped = 0
@@ -146,7 +147,8 @@ class TelemetrySink:
     def _open_file(self, path: str) -> None:
         self._fh = open(path, "w")
         self._fh.write(json.dumps(
-            registry.make_header(self._static, guards=self._guards)) + "\n")
+            registry.make_header(self._static, guards=self._guards,
+                                 fleet=self._fleet)) + "\n")
         self._fh.flush()
 
     def _maybe_rotate(self) -> None:
@@ -187,15 +189,54 @@ def read_run(path: str) -> Tuple[Dict, List[Dict]]:
     if not lines:
         raise ValueError(f"{path}: empty telemetry file")
     header, records = lines[0], lines[1:]
-    if header.get("schema") != registry.SCHEMA:
+    return _check_header(path, header), records
+
+
+def read_run_tolerant(path: str) -> Tuple[Dict, List[Dict], int]:
+    """``read_run`` for files a live writer may still be appending to:
+    torn (partially-written) lines are skipped and counted instead of
+    raising -> ``(header, records, skipped)``.
+
+    Only the line CONTENT is forgiven — a readable header with the wrong
+    schema/version still raises exactly like :func:`read_run` (a torn tail
+    is a liveness artifact; a foreign header is a misconfiguration the
+    monitor must surface, not average over). A torn HEADER line counts as
+    an unreadable file (ValueError), since nothing after it can be
+    trusted to be this schema."""
+    records: List[Dict] = []
+    header = None
+    skipped = 0
+    with open(path) as fh:
+        for ln in fh:
+            if not ln.strip():
+                continue
+            try:
+                obj = json.loads(ln)
+            except json.JSONDecodeError:
+                if header is None:
+                    raise ValueError(f"{path}: unreadable telemetry header")
+                skipped += 1
+                continue
+            if header is None:
+                header = _check_header(path, obj)
+            else:
+                records.append(obj)
+    if header is None:
+        raise ValueError(f"{path}: empty telemetry file")
+    return header, records, skipped
+
+
+def _check_header(path: str, header: Dict) -> Dict:
+    if not isinstance(header, dict) or header.get("schema") != registry.SCHEMA:
         # not a sink file — let callers decide (regress handles bench JSON)
+        schema = header.get("schema") if isinstance(header, dict) else None
         raise ValueError(f"{path}: not a {registry.SCHEMA} file "
-                         f"(schema={header.get('schema')!r})")
+                         f"(schema={schema!r})")
     if header.get("version") != registry.SCHEMA_VERSION:
         raise SchemaMismatchError(
             f"{path}: schema version {header.get('version')} "
             f"(reader supports {registry.SCHEMA_VERSION})")
-    return header, records
+    return header
 
 
 def summarize(records: List[Dict]) -> Dict[str, Dict[str, float]]:

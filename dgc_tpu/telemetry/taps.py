@@ -21,7 +21,7 @@ import numpy as np
 
 from dgc_tpu.telemetry import registry
 
-__all__ = ["l2", "bucket_payload_stats", "assemble_step_stats",
+__all__ = ["l2", "l1", "bucket_payload_stats", "assemble_step_stats",
            "empty_bucket_stats", "pmean_stats"]
 
 
@@ -31,6 +31,14 @@ def l2(x: Optional[jax.Array]) -> jax.Array:
         return jnp.zeros((), jnp.float32)
     xf = x.astype(jnp.float32)
     return jnp.sqrt(jnp.sum(xf * xf))
+
+
+def l1(x: Optional[jax.Array]) -> jax.Array:
+    """f32 L1 mass (sum of |x|); 0 for None/empty. The additive quantity
+    the elastic reshard conserves per worker — see resilience/elastic.py."""
+    if x is None or x.size == 0:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sum(jnp.abs(x.astype(jnp.float32)))
 
 
 def bucket_payload_stats(vals: jax.Array, gidx: jax.Array, sentinel: int):
@@ -55,13 +63,15 @@ def empty_bucket_stats(num_buckets: int = 0) -> Dict[str, jax.Array]:
 
 
 def assemble_step_stats(*, grad_norm, momentum_norm, residual_norm,
-                        clip_delta, payload_elems, wire_bytes,
-                        selected_frac, threshold) -> Dict[str, jax.Array]:
+                        residual_mass, clip_delta, payload_elems,
+                        wire_bytes, selected_frac,
+                        threshold) -> Dict[str, jax.Array]:
     """Assemble + schema-check the per-step stat pytree (registry names)."""
     stats = {
         "grad_norm": grad_norm,
         "momentum_norm": momentum_norm,
         "residual_norm": residual_norm,
+        "residual_mass": residual_mass,
         "clip_delta": clip_delta,
         "payload_elems": payload_elems,
         "wire_bytes": wire_bytes,

@@ -134,7 +134,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                      use_dropout: bool = False, donate: bool = True,
                      flat: Optional[FlatSetup] = None,
                      model_dtype=None, telemetry: bool = False,
-                     guards=None):
+                     guards=None, fleet: bool = False):
     """Build the jitted data-parallel DGC train step.
 
     Returns ``step_fn(state, images, labels, key) -> (state, metrics)`` where
@@ -183,7 +183,23 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     ``[2]`` vector, and the skip is a traced select — no host syncs. The
     default None compiles the guards away byte-identically (contract-
     pinned in ``dgc_tpu.analysis.suite``).
+
+    ``fleet=True`` (requires ``telemetry=True``): cross-worker dispersion
+    taps (``dgc_tpu.telemetry.fleet``, ISSUE 10). The step signature
+    gains a fifth argument — ``step_fn(state, images, labels, key,
+    clock)`` where ``clock`` is the host-stamped [world] f32 dispatch-
+    interval input (``fleet.make_clock``) — and the metrics dict gains a
+    ``"fleet"`` pytree (``registry.FLEET_METRICS``: per-worker clock/
+    grad-norm/residual-mass/sent-ratio columns + straggler/skew scalars).
+    The telemetry pmean is REPLACED by one packed all_gather that yields
+    both the telemetry means and the fleet columns, so the fleet build
+    costs at most ONE packed collective over the plain step and zero
+    host syncs (contract-pinned). ``fleet=False`` traces none of it:
+    byte-identical to the pre-fleet program.
     """
+    if fleet and not telemetry:
+        raise ValueError("fleet dispersion taps require telemetry=True "
+                         "(they extend the telemetry lane)")
     if telemetry and flat is None:
         raise ValueError("telemetry taps require the flat engine path "
                          "(pass flat=make_flat_setup(...))")
@@ -238,7 +254,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
 
     per_worker_opt = dist_opt.per_worker_opt_state
 
-    def worker(state: TrainState, images, labels, key):
+    def worker(state: TrainState, images, labels, key, clock=None):
         if (flat is not None and model_dtype is None
                 and getattr(dist_opt.compressor, "attributes", None)):
             # break XLA's view of the per-tensor params as one [P]
@@ -355,7 +371,15 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             with _trace.phase("loss"):
                 mean_loss = jax.lax.psum(loss, axes) / world
         metrics = {"loss": mean_loss}
-        if telemetry:
+        if fleet:
+            # ONE packed all_gather yields the telemetry means AND the
+            # per-worker dispersion columns — the pmean below is subsumed
+            # (a gather strictly dominates a mean), so the fleet build
+            # costs at most one packed collective over the plain step
+            from dgc_tpu.telemetry import fleet as _fleet
+            metrics["telemetry"], metrics["fleet"] = _fleet.gather_stats(
+                tstats, axes, clock=clock, total_elems=layout.total)
+        elif telemetry:
             # per-worker stats -> replicated (mesh mean), matching the
             # loss: the collective rides the same program (no dispatch)
             from dgc_tpu.telemetry import taps
@@ -397,6 +421,21 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     if guards is not None:
         from dgc_tpu.telemetry import registry
         metric_specs["guards"] = registry.guard_out_specs(P)
+    if fleet:
+        from dgc_tpu.telemetry import registry
+        metric_specs["fleet"] = registry.fleet_out_specs(P)
+
+        @partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def step_fn(state, images, labels, key, clock):
+            specs = state_specs(state, axes, per_worker_opt)
+            sharded = shard_map(
+                worker, mesh=mesh,
+                in_specs=(specs, P(axes), P(axes), P(), P(axes)),
+                out_specs=(specs, metric_specs),
+                check_vma=False)
+            return sharded(state, images, labels, key, clock)
+
+        return step_fn
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step_fn(state, images, labels, key):

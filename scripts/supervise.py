@@ -36,8 +36,16 @@ Mechanics:
   failures.
 * SIGTERM/SIGINT to the supervisor forwards to the child and STOPS the
   relaunch loop (the scheduler wants us gone, not respawning).
-* one JSONL event stream (``--events``) records every launch, exit,
-  backoff, and the final verdict, for postmortems and the smoke test.
+* one JSONL event stream (``--events-out``; legacy alias ``--events``)
+  records every launch, exit, backoff, and the final verdict, for
+  postmortems, the smoke test, and the live monitor
+  (``python -m dgc_tpu.telemetry.monitor``). When unset it defaults to
+  ``supervise_events.jsonl`` next to the ``--watch`` checkpoint dir —
+  i.e. under the run dir, where the monitor looks for it. Every event is
+  stamped with a per-supervisor ``run_id`` and the cohort spec
+  (``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` /
+  ``JAX_COORDINATOR_ADDRESS``) from the latest env read, and the stream
+  is flushed per event so a tailing reader never waits on a buffer.
 """
 
 import argparse
@@ -77,6 +85,21 @@ def checkpoint_progress(watch_dir):
         return None
 
 
+#: cohort-spec env keys stamped into every event (the monitor's view of
+#: the world shape each launch ran under)
+COHORT_KEYS = ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+               "JAX_COORDINATOR_ADDRESS")
+
+
+def default_events_path(watch):
+    """``supervise_events.jsonl`` next to the watched checkpoint dir —
+    i.e. under the run dir, where the live monitor looks for it."""
+    if not watch:
+        return None
+    return os.path.join(os.path.dirname(os.path.abspath(watch)),
+                        "supervise_events.jsonl")
+
+
 class Supervisor:
     def __init__(self, cmd, retries=5, backoff=5.0, backoff_max=300.0,
                  env_file=None, watch=None, events=None,
@@ -92,15 +115,29 @@ class Supervisor:
         self.child = None
         self.shutting_down = False
         self.launches = 0
+        # one id per supervisor lifetime: every relaunch of this run
+        # shares it, a fresh supervisor gets a fresh one
+        self.run_id = time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}"
+        self.cohort = {k: os.environ.get(k) for k in COHORT_KEYS
+                       if os.environ.get(k) is not None}
+        self._events_fh = None
 
     def event(self, kind, **fields):
         rec = dict(fields, event=kind, t=time.time(),
-                   launches=self.launches)
+                   launches=self.launches, run_id=self.run_id,
+                   cohort=self.cohort)
         line = json.dumps(rec)
         print(f"[supervise] {line}", flush=True)
         if self.events_path:
-            with open(self.events_path, "a") as f:
-                f.write(line + "\n")
+            # persistent handle, flushed per event: a tailing monitor
+            # sees every launch/relaunch as it happens, and relaunch
+            # churn doesn't reopen the file hundreds of times
+            if self._events_fh is None:
+                d = os.path.dirname(os.path.abspath(self.events_path))
+                os.makedirs(d, exist_ok=True)
+                self._events_fh = open(self.events_path, "a")
+            self._events_fh.write(line + "\n")
+            self._events_fh.flush()
 
     def _forward(self, signum, frame):
         # the scheduler is tearing US down: stop relaunching, pass the
@@ -120,6 +157,10 @@ class Supervisor:
             env = dict(os.environ)
             overrides = parse_env_file(self.env_file)
             env.update(overrides)
+            # latest cohort spec (the env-file may have re-shaped the
+            # world since the last launch) rides every event from here on
+            self.cohort = {k: env.get(k) for k in COHORT_KEYS
+                           if env.get(k) is not None}
             before = checkpoint_progress(self.watch)
             self.launches += 1
             self.event("launch", cmd=self.cmd,
@@ -175,8 +216,13 @@ def main(argv=None):
     parser.add_argument("--watch", default=None,
                         help="checkpoint directory; progress in its "
                              "latest.json resets the retry budget")
+    parser.add_argument("--events-out", default=None,
+                        help="append one JSON line per supervisor event; "
+                             "defaults to supervise_events.jsonl next to "
+                             "the --watch dir (under the run dir)")
     parser.add_argument("--events", default=None,
-                        help="append one JSON line per supervisor event")
+                        help="legacy alias for --events-out (takes "
+                             "precedence when both are given)")
     parser.add_argument("--success-codes", default="0",
                         help="comma-separated child exit codes that end "
                              "the loop successfully")
@@ -188,10 +234,12 @@ def main(argv=None):
         cmd = cmd[1:]
     if not cmd:
         parser.error("no training command given (put it after --)")
+    events = (args.events or args.events_out
+              or default_events_path(args.watch))
     sup = Supervisor(
         cmd, retries=args.retries, backoff=args.backoff,
         backoff_max=args.backoff_max, env_file=args.env_file,
-        watch=args.watch, events=args.events,
+        watch=args.watch, events=events,
         success_codes={int(c) for c in args.success_codes.split(",")})
     return sup.run()
 

@@ -43,6 +43,14 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "PLANNER_SMOKE=ok" || { echo "PLANNER_SMOKE=FAIL"; rc=1; }
+# fleet monitor smoke (docs/TELEMETRY.md §Fleet monitoring): registry fleet
+# schema, the packed in-graph gather's straggler verdict, tolerant shard
+# readers + multi-host merge, rolling-band desync detector, and the
+# monitor's OpenMetrics/status renderers + HTTP endpoint — all offline
+# against synthetic runs, plus one tiny 8-fake-device gather
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "MONITOR_SMOKE=ok" || { echo "MONITOR_SMOKE=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite — nonzero on any un-allowlisted finding
 # or broken step invariant (one sparse exchange, telemetry compiles away,

@@ -219,3 +219,109 @@ def test_elastic_cross_topology_resume(tmp_path):
     first6 = sum(base["losses"][:6]) / 6
     last6 = sum(base["losses"][-6:]) / 6
     assert last6 < first6
+
+
+def test_fleet_two_process_straggler(tmp_path):
+    """Fleet observability drill (docs/TELEMETRY.md §Fleet monitoring):
+    run the fleet train step across 2 real processes with
+    ``DGC_FAULTS=slow:ms=350`` armed on process 1 only. The injected
+    host-side sleep stretches only that process's dispatch intervals, so
+    the in-graph straggler verdict, the merged host-shard fleet view, and
+    the monitor's straggler table must all name one of process 1's
+    workers (4-7) — while the desync detector stays quiet on the healthy
+    residual-mass cohort, and fires once we corrupt one worker's recorded
+    residual-mass column."""
+    worker = os.path.join(os.path.dirname(__file__), "fleet_worker.py")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DGC_FAULTS")}
+    logs = [open(tmp_path / f"fleet_w{i}.log", "w+") for i in range(2)]
+    procs = []
+    for i in range(2):
+        e = dict(env)
+        if i == 1:
+            e["DGC_FAULTS"] = "slow:ms=350"
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(i), "2", coord, str(tmp_path)],
+            stdout=logs[i], stderr=subprocess.STDOUT, text=True, env=e))
+    outs = []
+    for p, lf in zip(procs, logs):
+        p.wait(timeout=1500)
+        lf.seek(0)
+        outs.append(lf.read())
+        lf.close()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"fleet proc {i} failed:\n{out[-4000:]}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT:"):
+                r = json.loads(line[len("RESULT:"):])
+                results[r["proc"]] = r
+    assert set(results) == {0, 1}
+
+    # in-graph verdict is replicated: both processes saw the same columns
+    assert results[0]["stragglers"] == results[1]["stragglers"]
+    # steady state (skip warmup: step 0 stamps dt=0, step 1 absorbs the
+    # compile): the straggler is one of process 1's workers (4-7)
+    tail = results[0]["stragglers"][2:]
+    slow_hits = sum(1 for s in tail if s >= 4)
+    assert slow_hits >= len(tail) - 1, \
+        f"straggler verdicts did not name process 1: {results[0]}"
+
+    # --- host-side: merge the per-host shards into the fleet view ---
+    from dgc_tpu.telemetry import fleet, monitor
+
+    run_dir = str(tmp_path / "fleetrun")
+    view = fleet.load_view(run_dir)
+    assert sorted(view.hosts) == ["host0", "host1"]
+    assert view.world == 8 and view.skipped == 0
+    assert len(view.steps) >= 10
+
+    table = fleet.straggler_table(view)
+    assert len(table) == 8
+    assert table[0]["worker"] >= 4, f"straggler table: {table[:2]}"
+    assert table[0]["share"] > 1.0
+
+    summary = fleet.fleet_summary(view)
+    assert summary["straggler"] >= 4
+    assert summary["straggler_gap"] > 100.0      # ms: the injected sleep
+    # healthy run: the residual/grad-mass desync detector stays quiet
+    assert summary["desync_alerts"] == 0, summary
+
+    # --- monitor renders both projections from the recorded run ---
+    snap = monitor.collect(run_dir)
+    om = monitor.render_openmetrics(snap)
+    assert om.endswith("# EOF\n")
+    assert 'dgc_worker_clock_ms{worker="7"}' in om
+    assert "dgc_straggler_gap_ms" in om and "dgc_worker_skew" in om
+    status = monitor.render_status(snap)
+    assert "straggler" in status and "desync: quiet" in status
+
+    # --- corrupted-residual drill: rewrite ONE worker's recorded
+    # residual-mass column with a multiplicative walk-away; the detector
+    # must fire and name that worker ---
+    bad = 5
+    corrupt = tmp_path / "fleetrun_corrupt"
+    for host, files in fleet.discover_shards(run_dir).items():
+        hd = corrupt / "telemetry" / host
+        hd.mkdir(parents=True)
+        for f in files:
+            out_lines = []
+            for ln in open(f):
+                rec = json.loads(ln)
+                col = rec.get("w_residual_mass")
+                if isinstance(col, list) and "step" in rec:
+                    drift = 1.0 + 0.9 * max(0, int(rec["step"]) - 4)
+                    col[bad] = col[bad] * drift
+                out_lines.append(json.dumps(rec))
+            (hd / os.path.basename(f)).write_text(
+                "\n".join(out_lines) + "\n")
+    cview = fleet.load_view(str(corrupt))
+    alerts = fleet.detect_desync(
+        fleet.worker_series(cview, "w_residual_mass"))
+    assert alerts, "corrupted residual column must trip the detector"
+    assert {a.worker for a in alerts} == {bad}
+    csummary = fleet.fleet_summary(cview)
+    assert csummary["desync_alerts"] > 0
+    assert csummary["desync_workers"] == [bad]

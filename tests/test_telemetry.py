@@ -101,7 +101,7 @@ def test_empty_bucket_stats_shapes():
 def test_assemble_step_stats_schema_and_dtype():
     stats = taps.assemble_step_stats(
         grad_norm=1.0, momentum_norm=2.0, residual_norm=3.0,
-        clip_delta=0.0, payload_elems=10, wire_bytes=80,
+        residual_mass=4.0, clip_delta=0.0, payload_elems=10, wire_bytes=80,
         selected_frac=jnp.asarray([0.1]), threshold=jnp.asarray([0.5]))
     assert set(stats) == set(registry.step_stat_names())
     assert all(v.dtype == jnp.float32 for v in stats.values())

@@ -426,24 +426,45 @@ def main():
     # never adds a host sync. Coordinator-only files, like MetricWriter.
     tcfg = configs.train.get("telemetry", None)
     telemetry_on = bool(tcfg and tcfg.get("enabled", False))
+    # fleet dispersion taps (configs/fleet.py, docs/TELEMETRY.md §Fleet
+    # monitoring): per-worker columns in every record, a host-stamped
+    # dispatch-interval clock input, and EVERY process writing its own
+    # host<i>/ sink shard so the run-level aggregator
+    # (dgc_tpu.telemetry.fleet / the live monitor) can merge the cohort
+    fleet_on = bool(telemetry_on and tcfg.get("fleet", False))
     sink = None
     if telemetry_on:
         from dgc_tpu.telemetry.sink import TelemetrySink
         telem_every = int(tcfg.get("every", 1) or 1)
+        if fleet_on:
+            sink_path = os.path.join(configs.train.save_path, "telemetry",
+                                     f"host{jax.process_index()}")
+            sink_enabled = True
+        else:
+            sink_path = os.path.join(configs.train.save_path, "telemetry")
+            sink_enabled = jax.process_index() == 0
         sink = TelemetrySink(
-            os.path.join(configs.train.save_path, "telemetry"),
+            sink_path,
             static=dict(flat_setup.engine.telemetry_static(),
-                        world=world, num_local_workers=num_local),
+                        world=world, num_local_workers=num_local,
+                        process_index=jax.process_index(),
+                        num_processes=jax.process_count()),
             rotate_bytes=int(tcfg.get("rotate_mb", 64)) << 20,
-            enabled=jax.process_index() == 0,
-            guards=guards_cfg is not None)
-        printr(f"[telemetry] -> {sink.path or '(non-coordinator)'}")
+            enabled=sink_enabled,
+            guards=guards_cfg is not None, fleet=fleet_on)
+        printr(f"[telemetry] -> {sink.path or '(non-coordinator)'}"
+               + (" [fleet]" if fleet_on else ""))
         if elastic_pending is not None:
             # the restore resharded across a topology change: record it
             # in the telemetry stream so readers can re-anchor per-worker
             # columns (same pattern as the engine_rebuild event)
             sink.write_record(dict(elastic_pending,
                                    event="elastic_restart"))
+    if fleet_on:
+        from dgc_tpu.telemetry import fleet as _fleet
+    # previous step's dispatch stamp (the fleet step-time proxy); host
+    # wall clock, never read inside the traced step
+    prev_dispatch = None
 
     # structured tracing (configs/trace.py or --trace, docs/TELEMETRY.md
     # §Tracing): device-side dgcph.* phase markers must be enabled BEFORE
@@ -525,7 +546,8 @@ def main():
                                        flat=flat_setup,
                                        model_dtype=_narrow_model_dtype(model),
                                        telemetry=telemetry_on,
-                                       guards=guards_cfg)
+                                       guards=guards_cfg,
+                                       fleet=fleet_on)
             if sink is not None:
                 # engine geometry changes with the warm-up ratio: record
                 # it so readers can re-anchor the per-bucket columns
@@ -583,10 +605,34 @@ def main():
                 # as soon as the step is enqueued) — device-side time
                 # lives in the profiler trace, not here
                 with tracer.span("step_dispatch", step=gstep):
-                    state, metrics = step_fn(
-                        state, images, labels,
-                        jax.random.fold_in(
-                            base_key, epoch * 100003 + bidx))
+                    if fleet_on:
+                        # deterministic straggler drill (DGC_FAULTS=
+                        # slow:ms=M on ONE process): sleep BEFORE the
+                        # stamp so the injected lag lands in this
+                        # process's prep interval
+                        from dgc_tpu.resilience import faults as _flt
+                        if _flt.armed():
+                            _flt.maybe_slow()
+                        # w_clock lane: host PREP time — previous
+                        # dispatch RETURN to this dispatch START. The
+                        # dispatch call can block on the cohort
+                        # collective; that wait equalizes across hosts
+                        # and would erase the straggler's signature, so
+                        # it stays outside the stamp.
+                        now = time.perf_counter()
+                        dt_ms = ((now - prev_dispatch) * 1000.0
+                                 if prev_dispatch is not None else 0.0)
+                        state, metrics = step_fn(
+                            state, images, labels,
+                            jax.random.fold_in(
+                                base_key, epoch * 100003 + bidx),
+                            _fleet.make_clock(dt_ms, mesh, world))
+                        prev_dispatch = time.perf_counter()
+                    else:
+                        state, metrics = step_fn(
+                            state, images, labels,
+                            jax.random.fold_in(
+                                base_key, epoch * 100003 + bidx))
                 if profile_left:
                     profile_left -= 1
                     if profile_left == 0:
@@ -616,6 +662,11 @@ def main():
                     stats = metrics["telemetry"]
                     if guards_cfg is not None:
                         stats = {**stats, **metrics["guards"]}
+                    if fleet_on:
+                        # fleet columns + loss ride the same record
+                        # (key-additive) so the monitor sees them all
+                        stats = {**stats, **metrics["fleet"],
+                                 "loss": metrics["loss"]}
                     sink.write(num_inputs, stats)
                 logged = bidx % 50 == 0
                 if logged:
