@@ -459,6 +459,7 @@ def main():
     #     model here beyond the dense term it already pays); all-dense
     #     plans drop the DGC overhead entirely -> ratio 1.0, never
     #     worse than the baseline.
+    from dgc_tpu.compression.autotune import Autotuner, regime_histogram
     from dgc_tpu.compression.planner import BUILTIN_FABRICS, plan_engine
     planned = {}
     for fab_key, fab_name, gbps, workers in (
@@ -471,13 +472,29 @@ def main():
             gbps * 1e9) * 1e3
         if plan.all_dense:
             realized = dense_ex
+            per_bucket = []
         else:
             eng_p = comp.make_flat_exchange(dgc_setup.layout, plan=plan)
-            wire = sum(eng_p.bucket_wire_bytes())
+            per_bucket = eng_p.bucket_wire_bytes()
+            wire = sum(per_bucket)
             realized = dgc_overhead_ms + (
                 (workers - 1) * wire) / (gbps * 1e9) * 1e3
+        # one autotune refit cycle over the model's own per-bucket
+        # (bytes, ms) points: a stable planner refits to the same plan,
+        # so replan_count 0 is the expected baseline — a drifting value
+        # in a BENCH artifact flags a decision-boundary regression
+        tuner = Autotuner(fabric=BUILTIN_FABRICS[fab_name], world=workers)
+        tuner.plan_for(dgc_setup.engine)
+        for nbytes in per_bucket:
+            if nbytes > 0:
+                # per-hop ms (the planner's wire model re-applies its
+                # own (W-1) ring factor)
+                tuner.record_step(nbytes / (gbps * 1e9) * 1e3, nbytes)
+        tuner.epoch_end(dgc_setup.engine)
         planned[fab_key] = {
             "regimes": list(plan.regimes),
+            "regime_histogram": regime_histogram(plan.regimes),
+            "replan_count": tuner.replan_count,
             "predicted_planned_ms": round(pred["planned_ms"], 5),
             "predicted_dense_ms": round(pred["dense_ms"], 5),
             "predicted_ratio": round(pred["ratio"], 3),
@@ -487,7 +504,8 @@ def main():
         }
         print(f"[planned {fab_key}] regimes {list(plan.regimes)} | dense "
               f"{dense_ex:.4f} ms | planned {realized:.4f} ms | ratio "
-              f"{dense_ex / realized:.2f}x (model {pred['ratio']:.2f}x)",
+              f"{dense_ex / realized:.2f}x (model {pred['ratio']:.2f}x) | "
+              f"replans {tuner.replan_count}",
               file=sys.stderr)
 
     # spread of the paired per-round overhead: the recorded artifact must

@@ -1,0 +1,33 @@
+"""Online exchange replanning knob (docs/PLANNER.md §Autotuning):
+append to any config stack to close the planner loop at runtime:
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/autotune.py
+
+What it enables:
+* an initial regime plan over the engine's buckets (the PR-7 planner;
+  fabric resolves through env ``DGC_FABRIC`` -> ``runs/fabric.json`` ->
+  the 32x25GbE built-in);
+* per-step host dispatch-interval (bytes, ms) points, plus per-bucket
+  ``allgather`` device costs whenever a ``profile.json`` exists in the
+  save path (dgc_tpu.telemetry.attrib);
+* an epoch-boundary link-model refit (``fit_link_model`` with the
+  current fabric as the degenerate-input prior), persisted
+  provenance-stamped to ``<save_path>/fabric.json``;
+* a replan that rebuilds the compiled step ONLY when the plan's
+  ``key()`` changes — same-key refits cost zero recompiles and zero
+  extra collectives (the ``autotune-replan-pins-compile`` contract in
+  dgc_tpu/analysis/suite.py).
+
+With this module absent none of these paths run and the lowered step
+program is byte-identical (the ``autotune-off-compiles-away``
+contract).
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+configs.train.autotune = Config()
+configs.train.autotune.enabled = True
+# points required before the first refit (a single step interval is not
+# a fit); the pool accumulates across epochs
+configs.train.autotune.min_points = 2

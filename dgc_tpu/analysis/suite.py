@@ -328,7 +328,8 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
     # (re-fit to the fixture's buckets) supplies the expectation, and
     # the step adds exactly one loss-mean all-reduce on top.
     from dgc_tpu.compression.planner import plan_buckets
-    for reg in ("dense", "fp32", "int8", "int8_packed"):
+    for reg in ("dense", "fp32", "int8", "int8_packed", "int4_packed",
+                "int8_delta_idx"):
         seed_plan = plan_buckets([], fabric="32x25GbE", world=8,
                                  candidates=(reg,))
         state_p, step_p, setup_p, _ = build_fixture(
@@ -339,6 +340,57 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
             f"plan-matches-collectives[{reg}]", state_p, step_p, inputs,
             collectives=want, no_f64=True)
         run(pmc.name, pmc.check)
+
+    # autotune off (ISSUE 11): a build that never names a plan or an
+    # Autotuner IS the plain build, byte for byte, and no autotune code
+    # lowers into the step even with the module imported — the whole
+    # replanning loop is host-side Python
+    import dgc_tpu.compression.autotune  # noqa: F401 — import must not leak
+    _, step_atoff, _, _ = build_fixture(mesh, donate=False, telemetry=False)
+    atoff = _step_contract(
+        "autotune-off-compiles-away", state, step_atoff, inputs,
+        forbid_substrings=["compression/autotune"],
+        identical_to=plain)
+    run(atoff.name, atoff.check)
+
+    # online replanning: an epoch-boundary refit whose plan key() is
+    # unchanged must cost ZERO recompiles (the stable autotuned-<base>
+    # fabric name keeps key() fixed unless the REGIMES move) and the
+    # autotuned build's collectives are exactly the plan's prediction —
+    # the refit adds no exchange of its own
+    def autotune_pin():
+        from dgc_tpu.compression.autotune import Autotuner
+        images_a, labels_a, key_a = inputs
+        probe = build_fixture(mesh, donate=False, telemetry=False)[2]
+        tuner = Autotuner(fabric="32x25GbE", world=8, min_points=2)
+        state_a, step_a, setup_a, _ = build_fixture(
+            mesh, donate=False, telemetry=False,
+            plan=tuner.plan_for(probe.engine))
+        out = []
+        if setup_a.engine.plan.key() != tuner.plan.key():
+            out.append("realized plan key differs from the tuner's plan")
+        want = dict(setup_a.engine.plan.collectives(dense_reduces=1))
+        want["all-reduce"] += 1     # the step's loss mean
+        out += Contract(
+            "autotune-replan-pins-compile", step_a,
+            args=(state_a, images_a, labels_a, key_a)).expects(
+            collectives=want, no_f64=True).check()
+        with RecompileGuard(step_a, expect=1,
+                            name="autotune-replan-pins-compile"):
+            step_a(state_a, images_a, labels_a, key_a)
+            # self-consistent refit: points on the fabric's own line,
+            # so the replanned key cannot move
+            for b in (1e4, 1e5, 1e6):
+                tuner.record_step(
+                    tuner.fabric.alpha_ms + b / (tuner.fabric.gbps * 1e6),
+                    int(b))  # dgclint: ok[sync-in-loop] — b is a Python loop constant, not a step output
+            if tuner.epoch_end(setup_a.engine) is not None:
+                out.append("same-key refit signalled a rebuild")
+            if tuner.refit_count != 1:
+                out.append("refit did not run")
+            step_a(state_a, images_a, labels_a, jax.random.PRNGKey(3))
+        return out
+    run("autotune-replan-pins-compile", autotune_pin)
 
     run("fused-epilogue-no-opt-barriers",
         lambda: _epilogue_contract().check())

@@ -5,6 +5,7 @@ from dgc_tpu.compression.base import (
     FP16Compressor,
     NoneCompressor,
 )
+from dgc_tpu.compression.autotune import Autotuner, regime_histogram
 from dgc_tpu.compression.dgc import DGCCompressor, TensorAttrs, sampling_geometry
 from dgc_tpu.compression.flat import FlatDGCEngine, FlatDenseExchange, ParamLayout
 from dgc_tpu.compression.memory import DGCSGDMemory, Memory
@@ -18,6 +19,8 @@ from dgc_tpu.compression.planner import (
 )
 
 __all__ = [
+    "Autotuner",
+    "regime_histogram",
     "Compression",
     "Compressor",
     "CompressCtx",

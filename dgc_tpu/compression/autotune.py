@@ -1,0 +1,253 @@
+"""Online exchange replanning: refit the link model from live telemetry
+and re-run the regime planner at epoch boundaries.
+
+The PR-7 planner chooses regimes ONCE at engine-build time from a static
+fabric model, but production fabrics drift (co-tenant contention, DCN
+congestion). The :class:`Autotuner` closes the loop host-side::
+
+    step loop   -> record_step(wall_ms)            (host stamps, no sync)
+    attrib      -> profile.json per-bucket allgather ms   (when traced)
+    fleet       -> w_clock per-worker lanes               (when enabled)
+                         |
+                 epoch boundary: epoch_end(engine)
+                         |
+        fit_link_model(points, prior=current fabric)
+                         |
+        persist  <save_path>/fabric.json  (provenance-stamped)
+                         |
+        plan_engine(engine, fabric=refit)  ->  key() comparison
+                         |
+        key unchanged -> keep the compiled step (ZERO recompiles)
+        key changed   -> caller rebuilds the engine once
+
+Zero-overhead invariants (contract-pinned in ``analysis/suite.py``):
+
+* everything here is host-side Python — a replan adds **zero extra
+  collectives** and, when ``key()`` is unchanged, **zero recompiles**
+  (the ``RecompileGuard`` pin);
+* with ``--autotune`` off, train.py takes none of these paths and the
+  lowered step program is byte-identical (``autotune-off-compiles-away``).
+
+The refit fabric keeps ONE stable name (``autotuned-<base>``) from the
+first plan on, so ``Plan.key()`` — ``(fabric.name, world, regimes)`` —
+changes exactly when the chosen *regimes* change: a refit that lands on
+the same per-bucket decisions costs nothing.
+"""
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dgc_tpu.compression.planner import (
+    DEFAULT_COST,
+    FABRIC_SCHEMA,
+    FABRIC_VERSION,
+    Fabric,
+    Plan,
+    REGIMES,
+    fit_link_model,
+    plan_engine,
+    resolve_fabric,
+)
+
+__all__ = ["Autotuner", "regime_histogram"]
+
+
+def regime_histogram(regimes: Sequence[str]) -> Dict[str, int]:
+    """``{regime: bucket count}`` of a plan's per-bucket choices (the
+    bench.py / telemetry record form — plain dict, stable key order)."""
+    out: Dict[str, int] = {}
+    for r in regimes:
+        out[r] = out.get(r, 0) + 1
+    return dict(sorted(out.items()))
+
+
+class Autotuner:
+    """Epoch-boundary replanner over one engine's exchange.
+
+    ``fabric`` resolves through :func:`planner.resolve_fabric` (None =
+    the documented env/``runs/fabric.json``/built-in chain) and is
+    immediately renamed to the stable ``autotuned-<base>`` identity the
+    refits keep. Measured (bytes, ms) points accumulate across epochs
+    — the fit only sharpens as the pool grows — and every refit uses
+    the CURRENT fabric as the degenerate-input prior
+    (:func:`planner.fit_link_model`), so a cluster of identical step
+    sizes can never produce an unphysical fit."""
+
+    def __init__(self, fabric=None, *, world: int,
+                 runs_dir: str = "runs",
+                 fabric_out: Optional[str] = None,
+                 candidates: Sequence[str] = REGIMES,
+                 cost=DEFAULT_COST,
+                 min_points: int = 2,
+                 max_points: int = 4096,
+                 sink=None):
+        base = resolve_fabric(fabric, runs_dir=runs_dir)
+        name = (base.name if base.name.startswith("autotuned-")
+                else f"autotuned-{base.name}")
+        self.base_name = base.name
+        self.fabric = Fabric(name, int(world), base.gbps, base.alpha_ms,
+                             measured=base.measured)
+        self.world = int(world)
+        self.candidates = tuple(candidates)
+        self.cost = cost
+        self.min_points = int(min_points)
+        self.max_points = int(max_points)
+        self.fabric_out = fabric_out
+        self.sink = sink
+        #: measured (wire bytes, ms) pool, newest last
+        self.points: List[Tuple[float, float]] = []
+        self.refit_count = 0      # fits performed
+        self.replan_count = 0     # fits whose plan key() changed
+        self._plan: Optional[Plan] = None
+
+    # -- planning --------------------------------------------------- #
+
+    @property
+    def plan(self) -> Optional[Plan]:
+        return self._plan
+
+    def plan_for(self, engine) -> Plan:
+        """Plan the engine's current bucket geometry under the current
+        (possibly refit) fabric — the rebuild path: a warm-up ratio
+        change reshapes the buckets, so the plan is always recomputed
+        against the engine that will realize it."""
+        self._plan = plan_engine(engine, fabric=self.fabric,
+                                 world=self.world, cost=self.cost,
+                                 candidates=self.candidates)
+        return self._plan
+
+    # -- measured inputs -------------------------------------------- #
+
+    def record_step(self, wall_ms: float, wire_bytes: int) -> None:
+        """One host-stamped step interval against the engine's static
+        per-worker wire bytes. Coarse (includes compute) but free; the
+        prior-pinned intercept keeps a same-size cluster from bending
+        alpha."""
+        if wall_ms > 0 and wire_bytes > 0:
+            self.points.append((float(wire_bytes), float(wall_ms)))
+            if len(self.points) > self.max_points:
+                del self.points[:len(self.points) - self.max_points]
+
+    def add_profile(self, profile: Optional[Dict], engine) -> int:
+        """Per-bucket allgather device ms from an
+        ``attrib.profile_json`` dict x the engine's per-bucket wire
+        bytes — the sharp input: every differently-sized bucket is a
+        distinct point on the line. Returns points added."""
+        if not profile:
+            return 0
+        buckets = (profile.get("dgc") or {}).get("buckets") or {}
+        wire = engine.bucket_wire_bytes()
+        added = 0
+        for i, nbytes in enumerate(wire):
+            tab = buckets.get(f"b{i}")
+            if not isinstance(tab, dict) or nbytes <= 0:
+                continue
+            ms = tab.get("allgather")
+            if isinstance(ms, (int, float)) and ms > 0:
+                self.record_step(float(ms), int(nbytes))  # dgclint: ok[sync-in-loop] — JSON profile value x static bucket bytes, host-side epoch-boundary code
+                added += 1
+        return added
+
+    def add_fleet_view(self, run_dir: str, wire_bytes: int,
+                       metric: str = "w_clock", last: int = 200) -> int:
+        """Per-step cohort max of a fleet lane (``telemetry.fleet``
+        sink shards) x the static wire bytes — the slowest worker
+        bounds the synchronous exchange. Tolerant: a missing or
+        unreadable run directory adds nothing."""
+        try:
+            from dgc_tpu.telemetry.fleet import load_view, worker_series
+            series = worker_series(load_view(run_dir), metric)
+        except Exception:
+            return 0
+        added = 0
+        for _, lanes in series[-last:]:
+            vals = [v for v in lanes if isinstance(v, (int, float))
+                    and np.isfinite(v) and v > 0]
+            if vals and wire_bytes > 0:
+                self.record_step(max(vals), wire_bytes)
+                added += 1
+        return added
+
+    # -- the refit -------------------------------------------------- #
+
+    def epoch_end(self, engine, epoch: Optional[int] = None,
+                  profile: Optional[Dict] = None) -> Optional[Plan]:
+        """Refit the link model over the accumulated points, persist
+        the provenance-stamped fabric, and replan. Returns the new
+        :class:`Plan` iff its ``key()`` differs from the active plan's
+        (the caller's rebuild trigger); None means the compiled step
+        stays exactly as-is."""
+        if profile:
+            self.add_profile(profile, engine)
+        if len(self.points) < self.min_points:
+            return None
+        alpha, gbps = fit_link_model(self.points, prior=self.fabric)
+        self.fabric = self.fabric._replace(
+            gbps=float(gbps), alpha_ms=float(alpha), measured=True)
+        self.refit_count += 1
+        if self.fabric_out:
+            self.write_fabric(self.fabric_out, epoch=epoch)
+        new = plan_engine(engine, fabric=self.fabric, world=self.world,
+                          cost=self.cost, candidates=self.candidates)
+        changed = self._plan is None or new.key() != self._plan.key()
+        if self.sink is not None:
+            self.sink.write_record({
+                "event": "autotune_replan",
+                "epoch": epoch,
+                "alpha_ms": self.fabric.alpha_ms,
+                "gbps": self.fabric.gbps,
+                "points": len(self.points),
+                "rebuilt": bool(changed),
+                "regimes": regime_histogram(new.regimes),
+            })
+        if not changed:
+            return None
+        self._plan = new
+        self.replan_count += 1
+        return new
+
+    # -- persistence ------------------------------------------------ #
+
+    def _fit_residual_ms(self) -> float:
+        """RMS of ``t - (alpha + bytes/bw)`` over the point pool — the
+        provenance quality stamp."""
+        beta = 1.0 / (self.fabric.gbps * 1e6)
+        errs = [t - (self.fabric.alpha_ms + b * beta)
+                for b, t in self.points]
+        return float(np.sqrt(np.mean(np.square(errs)))) if errs else 0.0
+
+    def write_fabric(self, path: str, epoch: Optional[int] = None) -> str:
+        """Schema-versioned ``fabric.json`` (``planner.load_fabric``
+        round-trips it; the provenance block rides as extra keys)."""
+        sizes = sorted({int(b) for b, _ in self.points})
+        obj = {
+            "schema": FABRIC_SCHEMA,
+            "version": FABRIC_VERSION,
+            "name": self.fabric.name,
+            "workers": self.fabric.workers,
+            "fit": {"alpha_ms": self.fabric.alpha_ms,
+                    "gbps": self.fabric.gbps},
+            "provenance": {
+                "source": "autotune",
+                "base": self.base_name,
+                "refit": self.refit_count,
+                "epoch": epoch,
+                "points": len(self.points),
+                "distinct_sizes": len(sizes),
+                "geometry_bytes": sizes[:64],
+                "fit_residual_ms": self._fit_residual_ms(),
+                "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            },
+        }
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(obj, fh, indent=2)
+        os.replace(tmp, path)
+        return path

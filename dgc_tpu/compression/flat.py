@@ -57,10 +57,14 @@ __all__ = ["ParamLayout", "FlatDGCEngine", "FlatDenseExchange"]
 #: kernels see aligned buffers and need no padding copies on the hot path
 _ALIGN = 16 * 128
 
-#: exchange regime -> (value kind, packed indices). "d" buckets ride the
+#: exchange regime -> (value kind, index lane). "d" buckets ride the
 #: dense-fallback psum; sparse kinds pick the value lane ("f32" native,
-#: "f16" half wire, "i8" int8 + per-row f32 scales) and ``packed`` the
-#: index lane (bit-packed words vs flat offsets). One regime per bucket,
+#: "f16" half wire, "i8" int8 + per-row f32 scales, "i4" nibble-packed
+#: int4 + per-bucket f32 scales riding the i8 q lane) and the index
+#: flag the index lane: False = plain flat offsets, True = bit-packed
+#: words (``wirecodec.IndexCodec``), "delta" = Elias-Fano words over
+#: the canonical sorted order (``wirecodec.DeltaIndexCodec``; both word
+#: streams share ONE gathered uint32 lane). One regime per bucket,
 #: chosen by ``compression.planner`` (or derived uniformly from the
 #: legacy compressor flags when no plan is given).
 _REGIMES = {
@@ -68,6 +72,8 @@ _REGIMES = {
     "fp32": ("f32", False), "fp32_packed": ("f32", True),
     "fp16": ("f16", False), "fp16_packed": ("f16", True),
     "int8": ("i8", False), "int8_packed": ("i8", True),
+    "int4_packed": ("i4", True),
+    "int8_delta_idx": ("i8", "delta"),
 }
 
 
@@ -649,7 +655,7 @@ class FlatDGCEngine:
             kof[kk] = lo + b.payload
         self._val_chunks = tuple(vloc)
         self._kind_payload = kof
-        iof = {True: 0, False: 0}
+        iof = {True: 0, False: 0, "delta": 0}
         iloc = []
         for b, p in zip(sparse, self._packed):
             iloc.append((p, iof[p], iof[p] + b.payload))
@@ -674,6 +680,29 @@ class FlatDGCEngine:
             self._row_map = jnp.asarray(np.concatenate(rm))
         else:
             self._row_map = None
+        #: int4 wire buckets (nibble-packed values on the i8 q lane):
+        #: per-slot bucket map for the per-BUCKET quantization scale
+        #: (one f32 each, appended to the f32 lane after the i8 row
+        #: scales) and a per-bucket byte layout — each bucket's nibble
+        #: stream pads to a whole byte on its own, so the per-bucket
+        #: wire accounting is exact
+        i4 = [b for b, kk in zip(sparse, self._kinds) if kk == "i4"]
+        self._i4_buckets = len(i4)
+        if i4 and self.payload_size:
+            self._i4_map = jnp.asarray(np.concatenate(
+                [np.full(b.payload, j, np.int32)
+                 for j, b in enumerate(i4)]))
+            ck, plo, blo = [], 0, 0
+            for b in i4:
+                nb = (b.payload + 1) // 2
+                ck.append((plo, plo + b.payload, blo, blo + nb))
+                plo, blo = plo + b.payload, blo + nb
+            self._i4_chunks = tuple(ck)
+            self._i4_bytes = blo
+        else:
+            self._i4_map = None
+            self._i4_chunks = ()
+            self._i4_bytes = 0
         #: static mask of int8 payload slots — only needed when int8
         #: error feedback must coexist with deferred-masking (non-i8)
         #: buckets in one mixed plan; None for every uniform plan
@@ -689,31 +718,60 @@ class FlatDGCEngine:
         # static tensor-local widths over the PACKED buckets; their
         # all_gather ships the uint32 bitstream instead of [payload]
         # int32 offsets (plain-index buckets keep their own lane)
-        pk = [b for b, p in zip(sparse, self._packed) if p]
+        pk = [b for b, p in zip(sparse, self._packed) if p is True]
         if pk and self.payload_size:
             from dgc_tpu.compression.wirecodec import IndexCodec
             self._codec = IndexCodec(pk)
         else:
             self._codec = None
-        # receiver-side index clamp bounds: packed slots enforce their
-        # static row bounds (exactly what an honest encode can produce);
-        # plain slots the generic [0, T) range. Mixed plans stitch one
-        # full-payload bounds pair; uniform plans keep the pre-planner
-        # arguments (codec arrays, or None/None for the generic clamp).
-        if self._codec is not None and self._plain_payload:
+        # Elias-Fano index wire (int8_delta_idx): its word stream rides
+        # the SAME gathered uint32 lane as the IndexCodec bitstream
+        # (codec words first, delta words after). Encode needs each
+        # delta bucket's payload sorted by canonical position, so the
+        # engine records the per-bucket payload slices + per-slot row
+        # bounds the sort key is built from (_sort_delta_payload).
+        dl = [b for b, p in zip(sparse, self._packed) if p == "delta"]
+        if dl and self.payload_size:
+            from dgc_tpu.compression.wirecodec import DeltaIndexCodec
+            self._dcodec = DeltaIndexCodec(dl)
+            ds, dj = [], 0
+            for (s0, s1), p in zip(self._payload_slices, self._packed):
+                if p == "delta":
+                    n = s1 - s0
+                    ds.append((s0, s1,
+                               self._dcodec.slot_off[dj:dj + n],
+                               self._dcodec.slot_numel[dj:dj + n]))
+                    dj += n
+            self._delta_sort = tuple(ds)
+        else:
+            self._dcodec = None
+            self._delta_sort = ()
+        # receiver-side index clamp bounds: packed/delta slots enforce
+        # their static row bounds (exactly what an honest encode can
+        # produce); plain slots the generic [0, T) range. Mixed plans
+        # stitch one full-payload bounds pair; uniform plans keep the
+        # pre-planner arguments (codec arrays, or None/None for the
+        # generic clamp).
+        word_codecs = [c for c in (self._codec, self._dcodec)
+                       if c is not None]
+        if len(word_codecs) == 1 and not self._plain_payload:
+            self._clamp_bounds = (word_codecs[0].slot_off,
+                                  word_codecs[0].slot_numel)
+        elif word_codecs:
             so = np.zeros((self.payload_size,), np.int64)
             sn = np.full((self.payload_size,), max(int(self.T), 1),
                          np.int64)
-            pj = 0
+            pj = dj = 0
             for (s0, s1), p in zip(self._payload_slices, self._packed):
-                if p:
+                if p is True:
                     so[s0:s1] = self._codec.slot_off[pj:pj + s1 - s0]
                     sn[s0:s1] = self._codec.slot_numel[pj:pj + s1 - s0]
                     pj += s1 - s0
+                elif p == "delta":
+                    so[s0:s1] = self._dcodec.slot_off[dj:dj + s1 - s0]
+                    sn[s0:s1] = self._dcodec.slot_numel[dj:dj + s1 - s0]
+                    dj += s1 - s0
             self._clamp_bounds = (so, sn)
-        elif self._codec is not None:
-            self._clamp_bounds = (self._codec.slot_off,
-                                  self._codec.slot_numel)
         else:
             self._clamp_bounds = (None, None)
         #: opt-in payload checksum (resilience.integrity): one int32 word
@@ -728,6 +786,11 @@ class FlatDGCEngine:
                 "checksum=True is not supported with int8_values — the "
                 "per-row f32 scale wire would ride uncovered; use the "
                 "fp16/f32 value wire")
+        if self.checksum and self._i4_buckets:
+            raise ValueError(
+                "checksum=True is not supported with the int4_packed "
+                "wire — the per-bucket f32 scale wire would ride "
+                "uncovered; use the fp16/f32 value wire")
         sparse_set = set(r for r in regimes if r != "dense")
         if self.checksum and len(sparse_set) > 1:
             raise ValueError(
@@ -771,13 +834,53 @@ class FlatDGCEngine:
                  in zip(self._payload_slices, self._kinds) if k == kind]
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
-    def _packed_chunks(self, arr: jax.Array, packed: bool) -> jax.Array:
-        """Same, for the index lanes (packed words vs plain offsets)."""
-        if all(p == packed for p in self._packed):
+    def _packed_chunks(self, arr: jax.Array, packed) -> jax.Array:
+        """Same, for the index lanes (packed words / Elias-Fano words /
+        plain offsets — ``packed`` is the three-valued regime flag)."""
+        if all(p == packed for p in self._packed):  # dgclint: ok[tracer-branch] — self._packed is plan-static regime flags, not a tracer
             return arr
         parts = [arr[s0:s1] for (s0, s1), p
                  in zip(self._payload_slices, self._packed) if p == packed]
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def _sort_delta_payload(self, values: jax.Array, indices: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array]:
+        """Sort each ``int8_delta_idx`` bucket's payload slice by
+        canonical position — the Elias-Fano encode precondition
+        (wirecodec.DeltaIndexCodec). Values and ORIGINAL indices are
+        permuted together: downstream consumers (quantization, the
+        transmit record, int8 error feedback) keep seeing matched
+        (value, index) pairs with sentinels intact — a permutation
+        changes no transmitted coordinate set. The sort key is the
+        CANONICAL (in-row clipped) position so padded sentinel slots
+        sort inside their owning row; rows occupy disjoint ascending
+        ranges, so the sort never crosses rows and every static per-row
+        structure (_row_map, clamp bounds, slot ownership) stays
+        valid."""
+        for s0, s1, off, num in self._delta_sort:
+            seg = indices[s0:s1]
+            o = jnp.asarray(off, seg.dtype)
+            hi = jnp.asarray(num - 1, seg.dtype)
+            canon = o + jnp.clip(seg - o, 0, hi)
+            order = jnp.argsort(canon)
+            values = values.at[s0:s1].set(values[s0:s1][order])
+            indices = indices.at[s0:s1].set(seg[order])
+        return values, indices
+
+    def _decode_i4(self, g_q4: jax.Array, g_scale4: jax.Array,
+                   dt) -> jax.Array:
+        """Decode the gathered int4 nibble bytes back to values: unpack
+        each bucket's byte span (odd payloads drop the zero pad nibble),
+        then rescale by that bucket's f32 scale. ``g_q4`` is
+        [W, _i4_bytes] int8, ``g_scale4`` starts with the
+        [W, _i4_buckets] per-bucket scales; returns [W, i4 payload] in
+        ``dt``."""
+        from dgc_tpu.compression.wirecodec import unpack_int4
+        parts = [unpack_int4(g_q4[:, blo:bhi], phi - plo)
+                 for plo, phi, blo, bhi in self._i4_chunks]
+        q = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        scale = g_scale4[:, :self._i4_buckets].astype(dt)
+        return q.astype(dt) * jnp.take(scale, self._i4_map, axis=1)
 
     # -------------------------------------------------------------- #
     # telemetry geometry (dgc_tpu.telemetry)                         #
@@ -797,6 +900,8 @@ class FlatDGCEngine:
         val = 0
         if kp.get("i8"):
             val += kp["i8"] + 4 * self._i8_rows
+        if kp.get("i4"):
+            val += self._i4_bytes + 4 * self._i4_buckets
         if kp.get("f16"):
             val += 2 * kp["f16"]
         if kp.get("f32"):
@@ -804,6 +909,8 @@ class FlatDGCEngine:
         idx = 0
         if self._codec is not None:
             idx += 4 * self._codec.nwords
+        if self._dcodec is not None:
+            idx += 4 * self._dcodec.nwords
         if self._plain_payload:
             idx += (self._plain_payload
                     * jnp.dtype(self.index_dtype).itemsize)
@@ -819,7 +926,7 @@ class FlatDGCEngine:
         engine total by sub-word rounding in either direction:
         ``-(num packed buckets) < total - sum < 4`` bytes."""
         out = []
-        pj = 0
+        pj = dj = 0
         for b, r in zip(self.buckets, self.regimes):
             kind, packed = _REGIMES[r]
             if kind == "d":
@@ -827,14 +934,21 @@ class FlatDGCEngine:
                 continue
             if kind == "i8":
                 vb = b.payload + 4 * b.rows
+            elif kind == "i4":
+                # nibble bytes (per-bucket padded, exact) + ONE f32 scale
+                vb = (b.payload + 1) // 2 + 4
             elif kind == "f16":
                 vb = 2 * b.payload
             else:
                 vb = b.payload * np.dtype(self.layout.dtype).itemsize
-            if packed:
+            if packed is True:
                 w = self._codec.widths[pj:pj + b.payload]
                 pj += b.payload
                 ib = -(-int(w.sum()) // 8)
+            elif packed == "delta":
+                # the Elias-Fano stream word-aligns per bucket — exact
+                ib = 4 * self._dcodec.bucket_words[dj]
+                dj += 1
             else:
                 ib = b.payload * jnp.dtype(self.index_dtype).itemsize
             out.append(int(vb + ib))
@@ -1882,11 +1996,17 @@ class FlatDGCEngine:
         sel_stats: Optional[Dict] = {} if telemetry else None
         values, indices = self.sparsify(comp, key, seg_cands=cands,
                                         stats_out=sel_stats)
+        if self._dcodec is not None:
+            # Elias-Fano precondition: each delta bucket's payload slice
+            # sorted by canonical position BEFORE any lane packing, so
+            # the quantized q lane and the index stream stay aligned
+            with _trace.phase("pack"):
+                values, indices = self._sort_delta_payload(values, indices)
 
         dt = flat_grad.dtype
         kp = self._kind_payload
         int8_ef = False
-        f32_wire = f16_wire = q_wire = scale = None
+        f32_wire = f16_wire = q_wire = q4_wire = scale = scale4 = None
         if kp.get("i8"):
             # int8 wire lane: symmetric per-TENSOR quantization (one f32
             # scale per row, segment-max over the tight payload) — the
@@ -1924,14 +2044,38 @@ class FlatDGCEngine:
                 vc = vc.at[idx_i8].add(-dequant)
                 if m.momentum_masking:
                     mc = mc.at[idx_i8].set(jnp.zeros((), mc.dtype))
+        if kp.get("i4"):
+            # int4 wire lane: symmetric per-BUCKET quantization (one f32
+            # scale per bucket — the payload is small enough that a
+            # coarser scale granularity buys half the value bytes), two
+            # nibbles per byte, riding the i8 q lane after any int8
+            # payload. Per-bucket byte padding keeps the accounting
+            # exact (bucket_wire_bytes).
+            from dgc_tpu.compression.wirecodec import pack_int4
+            vals_i4 = self._kind_chunks(values, "i4")
+            with _trace.phase("pack"):
+                smax4 = jax.ops.segment_max(jnp.abs(vals_i4),
+                                            self._i4_map,
+                                            num_segments=self._i4_buckets)
+                scale4 = (smax4 / 7.0).astype(jnp.float32)
+                safe4 = jnp.where(scale4 > 0, scale4, 1.0)
+                q4 = jnp.clip(
+                    jnp.round(vals_i4 / jnp.take(safe4, self._i4_map)),
+                    -7, 7).astype(jnp.int32)
+                nb = [pack_int4(q4[plo:phi])
+                      for plo, phi, _, _ in self._i4_chunks]
+                q4_wire = nb[0] if len(nb) == 1 else jnp.concatenate(nb)
         # f32 value lane: native-dtype values of the f32-regime buckets,
-        # then the int8 per-row scales. A single part ships identity
-        # (uniform plans keep their exact pre-planner wire arrays);
-        # multiple parts promote to f32 for the concat.
+        # then the int8 per-row scales, then the int4 per-bucket scales.
+        # A single part ships identity (uniform plans keep their exact
+        # pre-planner wire arrays); multiple parts promote to f32 for
+        # the concat.
         f32_parts = ([self._kind_chunks(values, "f32")]
                      if kp.get("f32") else [])
         if scale is not None:
             f32_parts.append(scale)
+        if scale4 is not None:
+            f32_parts.append(scale4)
         if len(f32_parts) == 1:
             f32_wire = f32_parts[0]
         elif f32_parts:  # dgclint: ok[tracer-branch] — list emptiness is plan-static (kp/scale), not a tracer test
@@ -1939,9 +2083,13 @@ class FlatDGCEngine:
                 [p.astype(jnp.float32) for p in f32_parts])
         if kp.get("f16"):
             f16_wire = self._kind_chunks(values, "f16").astype(jnp.float16)
+        if q_wire is not None and q4_wire is not None:
+            q_lane = jnp.concatenate([q_wire, q4_wire])
+        else:
+            q_lane = q_wire if q_wire is not None else q4_wire
         with _trace.phase("allgather"):
-            g_q = (jax.lax.all_gather(q_wire, axis_name)
-                   if q_wire is not None else None)   # [W, i8 payload]
+            g_q = (jax.lax.all_gather(q_lane, axis_name)
+                   if q_lane is not None else None)  # [W, i8+i4 bytes]
             g_f32 = (jax.lax.all_gather(f32_wire, axis_name)
                      if f32_wire is not None else None)
             g_f16 = (jax.lax.all_gather(f16_wire, axis_name)
@@ -1955,19 +2103,32 @@ class FlatDGCEngine:
             with _trace.phase("decode"):
                 g_values = g_q.astype(dt) * jnp.take(
                     g_f32.astype(dt), self._row_map, axis=1)
+        elif kinds == {"i4"}:
+            # uniform int4 plan: the f32 lane is exactly the per-bucket
+            # scale vector
+            with _trace.phase("decode"):
+                g_values = self._decode_i4(g_q, g_f32, dt)
         else:
             # mixed plan: stitch the gathered lanes back into payload
             # order per sparse bucket ([W, payload], wire precision —
             # the shared .astype(dt) happens at the scatter below)
             with _trace.phase("decode"):
-                if g_q is not None:
-                    g_i8 = g_q.astype(dt) * jnp.take(
-                        g_f32[:, kp.get("f32", 0):].astype(dt),
+                n8 = kp.get("i8", 0)
+                f32_off = kp.get("f32", 0)
+                if n8:
+                    g_i8 = g_q[:, :n8].astype(dt) * jnp.take(
+                        g_f32[:, f32_off:].astype(dt),
                         self._row_map, axis=1)
+                if kp.get("i4"):
+                    g_i4 = self._decode_i4(
+                        g_q[:, n8:],
+                        g_f32[:, f32_off + self._i8_rows:], dt)
                 parts = []
                 for kk, lo, hi in self._val_chunks:
                     if kk == "i8":
                         parts.append(g_i8[:, lo:hi])
+                    elif kk == "i4":
+                        parts.append(g_i4[:, lo:hi])
                     elif kk == "f16":
                         parts.append(g_f16[:, lo:hi].astype(dt))
                     else:
@@ -1992,25 +2153,43 @@ class FlatDGCEngine:
                 chk = integrity.payload_checksum(
                     wire_values, idx_canon, self._seg_ids,
                     self._num_seg)
-        g_idx_packed = g_idx_plain = None
-        if self._codec is not None:
-            # packed index wire: gather the bitstream, decode per worker
-            # (static gathers + shifts; decoded == original for every
-            # real slot, padded slots land in-row with value 0.0)
+        g_idx_packed = g_idx_plain = g_idx_delta = None
+        if self._codec is not None or self._dcodec is not None:
+            # packed index wire: gather the bitstream(s), decode per
+            # worker (static gathers + shifts; decoded == original for
+            # every real slot, padded slots land in-row with value 0.0).
+            # Both codecs share ONE uint32 lane: IndexCodec words first
+            # (+ checksum words when on — checksum never co-occurs with
+            # delta buckets, the constructor rejects checksum+int8),
+            # Elias-Fano delta words after.
             with _trace.phase("pack"):
-                words = self._codec.encode(
-                    self._packed_chunks(indices, True))
-                if checksum:
-                    # int32 -> uint32 astype is a bit-preserving mod-2^32
-                    # wrap, undone symmetrically on the receiver
-                    words = jnp.concatenate([words, chk.astype(jnp.uint32)])
+                wparts = []
+                if self._codec is not None:
+                    wparts.append(self._codec.encode(
+                        self._packed_chunks(indices, True)))
+                    if checksum:
+                        # int32 -> uint32 astype is a bit-preserving
+                        # mod-2^32 wrap, undone symmetrically on the
+                        # receiver
+                        wparts.append(chk.astype(jnp.uint32))
+                if self._dcodec is not None:
+                    wparts.append(self._dcodec.encode(
+                        self._packed_chunks(indices, "delta")))
+                words = (wparts[0] if len(wparts) == 1
+                         else jnp.concatenate(wparts))
             with _trace.phase("allgather"):
                 g_words = jax.lax.all_gather(words, axis_name)
             with _trace.phase("decode"):
+                nc = self._codec.nwords if self._codec is not None else 0
                 if checksum:
-                    g_chk = g_words[:, self._codec.nwords:].astype(jnp.int32)
-                    g_words = g_words[:, :self._codec.nwords]
-                g_idx_packed = self._codec.decode(g_words, self.index_dtype)
+                    g_chk = g_words[:, nc:].astype(jnp.int32)
+                if self._dcodec is not None:
+                    g_idx_delta = self._dcodec.decode(
+                        g_words[:, nc:nc + self._dcodec.nwords],
+                        self.index_dtype)
+                if self._codec is not None:
+                    g_idx_packed = self._codec.decode(
+                        g_words[:, :nc], self.index_dtype)
         if self._plain_payload:
             with _trace.phase("pack"):
                 idx_wire = self._packed_chunks(indices, False)
@@ -2026,14 +2205,15 @@ class FlatDGCEngine:
                     g_idx_plain = g_idx_wire[:, :self._plain_payload]
                 else:
                     g_idx_plain = g_idx_wire
-        if g_idx_packed is None:
-            g_indices = g_idx_plain
-        elif g_idx_plain is None:
-            g_indices = g_idx_packed
+        srcs = {True: g_idx_packed, False: g_idx_plain,
+                "delta": g_idx_delta}
+        live = [g for g in srcs.values() if g is not None]
+        if len(live) == 1:
+            g_indices = live[0]
         else:
             with _trace.phase("decode"):
                 g_indices = jnp.concatenate(
-                    [(g_idx_packed if p else g_idx_plain)[:, lo:hi]
+                    [srcs[p][:, lo:hi]
                      for p, lo, hi in self._idx_chunks], axis=1)
         if _faults.armed():
             g_indices = _faults.corrupt_indices(g_indices)

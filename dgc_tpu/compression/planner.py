@@ -7,11 +7,16 @@ dwarfs a 0.005 ms dense psum when the wire is ~400x Ethernet. DGC is a
 slow-fabric algorithm; the fix is not a faster sparse path on ICI but a
 *policy*: per bucket, at engine-build time, choose among
 
-* ``dense``        — ride the always-present dense-fallback psum
-* ``fp32``         — sparse allgather, native values + int32 indices
-* ``int8``         — int8 values + per-row f32 scales + int32 indices
-* ``int8_packed``  — int8 values + scales + bit-packed tensor-local
+* ``dense``          — ride the always-present dense-fallback psum
+* ``fp32``           — sparse allgather, native values + int32 indices
+* ``int8``           — int8 values + per-row f32 scales + int32 indices
+* ``int8_packed``    — int8 values + scales + bit-packed tensor-local
   indices (``wirecodec.IndexCodec``)
+* ``int4_packed``    — 4-bit values (two per byte, one f32 scale per
+  bucket) + the bit-packed index stream
+* ``int8_delta_idx`` — int8 values + per-row scales + an Elias-Fano
+  (delta-then-bitpacked) index stream over the canonical sorted order
+  (``wirecodec.DeltaIndexCodec``)
 
 by evaluating a cost model over (a) a **fabric model** — either a
 built-in modeled fabric or a measured ``runs/fabric.json`` emitted by
@@ -56,13 +61,16 @@ __all__ = ["Fabric", "CostModel", "BucketGeom", "Plan",
            "BUILTIN_FABRICS", "DEFAULT_COST", "REGIMES",
            "FABRIC_SCHEMA", "FABRIC_VERSION",
            "fit_link_model", "load_fabric", "resolve_fabric",
-           "bucket_geometry", "packed_index_bits",
+           "bucket_geometry", "packed_index_bits", "delta_index_bits",
            "plan_buckets", "plan_engine", "bucket_ms_from_profile"]
 
 #: regimes the cost model ranks (the engine additionally accepts the
 #: legacy fp16 / fp16_packed / fp32_packed wire formats when a uniform
-#: plan is derived from compressor flags)
-REGIMES = ("dense", "fp32", "int8", "int8_packed")
+#: plan is derived from compressor flags). Ordered cheapest-compute
+#: first: ties break toward the EARLIER candidate, so the low-bit
+#: regimes must out-model int8_packed to win a bucket.
+REGIMES = ("dense", "fp32", "int8", "int8_packed", "int4_packed",
+           "int8_delta_idx")
 
 #: every wire format the engine can realize (REGIMES plus the legacy
 #: uniform formats derived from compressor flags) — Plan validates
@@ -116,11 +124,15 @@ DEFAULT_COST = CostModel()
 
 
 class BucketGeom(NamedTuple):
-    """The planner's static view of one engine bucket."""
+    """The planner's static view of one engine bucket. ``delta_bits``
+    trails with a conservative default so positional constructions from
+    before the ``int8_delta_idx`` regime stay valid (32 bits/index means
+    the delta stream never beats the packed one unless measured)."""
     numel: int           # real elements covered (sum of row numels)
     payload: int         # sparse payload slots per worker
     rows: int            # tensor rows (one f32 scale each on int8 wires)
     index_bits: float    # mean bit-packed index width (<= 32)
+    delta_bits: float = 32.0   # mean Elias-Fano index width
 
 
 def packed_index_bits(bucket) -> float:
@@ -133,26 +145,62 @@ def packed_index_bits(bucket) -> float:
     return float(widths.mean()) if widths.size else 32.0
 
 
+def delta_index_bits(bucket) -> float:
+    """Mean Elias-Fano index width of a ``flat._Bucket`` under the
+    ``int8_delta_idx`` wire — mirrors ``wirecodec.DeltaIndexCodec``'s
+    static layout: ``p*s`` low bits + ``p + (U >> s) + 1`` high bits
+    over ``p`` payload slots, ``s = floor(log2(U / p))``."""
+    U = int(bucket.rows) * int(bucket.cols)
+    p = int(bucket.payload)
+    if p <= 0 or U <= 0:
+        return 32.0
+    s = max(0, (max(U // p, 1)).bit_length() - 1)
+    return (p * s + p + (U >> s) + 1) / p
+
+
 def bucket_geometry(bucket) -> BucketGeom:
     """``flat._Bucket`` -> :class:`BucketGeom`."""
     return BucketGeom(numel=int(np.sum(bucket.numels)),
                       payload=int(bucket.payload),
                       rows=int(bucket.rows),
-                      index_bits=packed_index_bits(bucket))
+                      index_bits=packed_index_bits(bucket),
+                      delta_bits=delta_index_bits(bucket))
 
 
 # ------------------------------------------------------------------ #
 # fabric.json (scripts/measure_exchange.py --fabric-out)             #
 # ------------------------------------------------------------------ #
 
-def fit_link_model(points: Sequence[Tuple[float, float]]):
+def fit_link_model(points: Sequence[Tuple[float, float]],
+                   prior: Optional[Fabric] = None):
     """Least-squares ``ms = alpha + beta * bytes`` over measured
     (bytes, ms) points; returns ``(alpha_ms, gbps)`` with both clamped
-    to physical ranges (alpha >= 0, finite positive bandwidth)."""
+    to physical ranges (alpha >= 0, finite positive bandwidth).
+
+    With fewer than two DISTINCT byte sizes the two-parameter fit is
+    underdetermined (the lstsq solution is numerical noise, not
+    physics). When ``prior`` is given — the fabric the run was already
+    using, the autotuner's refit path — the intercept is pinned to the
+    prior's ``alpha_ms`` and only the bandwidth is re-solved from the
+    degenerate cluster; without a prior, one distinct size keeps the
+    historical single-point behavior (alpha 0) and zero usable points
+    raises."""
     pts = [(float(b), float(t)) for b, t in points if b > 0 and t > 0]
     if not pts:
         raise ValueError("fit_link_model: no usable (bytes, ms) points")
-    if len(pts) == 1:
+    distinct = len({b for b, _ in pts})
+    if distinct < 2:
+        if prior is not None:
+            alpha = max(float(prior.alpha_ms), 0.0)
+            # bandwidth from the cluster mean with the prior's intercept
+            # removed; a measurement faster than the intercept alone
+            # falls back to the prior's bandwidth rather than inventing
+            # an unphysical one
+            slopes = [(t - alpha) / b for b, t in pts if t > alpha]
+            if slopes:
+                beta = max(float(np.mean(slopes)), 1e-12)
+                return alpha, 1.0 / (beta * 1e6)
+            return alpha, float(prior.gbps)
         b, t = pts[0]
         return 0.0, b / (t * 1e6)
     xs = np.asarray([p[0] for p in pts])
@@ -183,19 +231,43 @@ def load_fabric(path: str) -> Fabric:
                   measured=True)
 
 
+def _log_fabric_source(source: str, fab: Fabric) -> None:
+    """One line naming which fallback-chain source won, so an
+    autotuner-refined ``runs/fabric.json`` is distinguishable from a
+    hand-built or built-in fabric in the run log."""
+    try:
+        from dgc_tpu.utils.logging import printr
+    except Exception:                                 # pragma: no cover
+        printr = print
+    printr(f"[fabric] {source} -> {fab.name} "
+           f"({'measured' if fab.measured else 'modeled'}, "
+           f"W={fab.workers}, {fab.gbps:.3g} GB/s, "
+           f"alpha {fab.alpha_ms:.3g} ms)")
+
+
 def resolve_fabric(spec=None, runs_dir: str = "runs") -> Fabric:
     """A :class:`Fabric` from a Fabric instance, a built-in name, a
     ``fabric.json`` path, or None (environment ``DGC_FABRIC``, then
     ``runs/fabric.json`` if present, then the 32x25GbE built-in — the
-    documented fallback when no measurement exists)."""
+    documented fallback when no measurement exists). The None fallback
+    chain logs which source won (explicit specs are already
+    unambiguous)."""
     if isinstance(spec, Fabric):
         return spec
     if spec is None:
         spec = os.environ.get("DGC_FABRIC", "")
-        if not spec:
-            default = os.path.join(runs_dir, "fabric.json")
-            return (load_fabric(default) if os.path.exists(default)
-                    else BUILTIN_FABRICS["32x25GbE"])
+        if spec:
+            fab = resolve_fabric(spec, runs_dir)
+            _log_fabric_source(f"env DGC_FABRIC={spec!r}", fab)
+            return fab
+        default = os.path.join(runs_dir, "fabric.json")
+        if os.path.exists(default):
+            fab = load_fabric(default)
+            _log_fabric_source(default, fab)
+        else:
+            fab = BUILTIN_FABRICS["32x25GbE"]
+            _log_fabric_source("builtin default", fab)
+        return fab
     if spec in BUILTIN_FABRICS:
         return BUILTIN_FABRICS[spec]
     if os.path.exists(spec):
@@ -254,12 +326,24 @@ def _regime_costs(g: BucketGeom, fabric: Fabric, world: int,
             g.payload * (1 + index_itemsize) + scales, 3),
         "int8_packed": comp + quant + pack + wire(
             g.payload * (1 + g.index_bits / 8) + scales, 3),
+        # 4-bit values, two per byte, ONE f32 scale per bucket; indices
+        # ride the same bit-packed stream as int8_packed. The extra
+        # sort/pack work is charged at the codec coefficient.
+        "int4_packed": comp + quant + 2 * pack + wire(
+            g.payload * (0.5 + g.index_bits / 8) + 4, 3),
+        # int8 values + per-row scales + the Elias-Fano index stream
+        # (delta-then-bitpack over the canonical sorted order); the
+        # per-bucket payload sort rides the pack coefficient.
+        "int8_delta_idx": comp + quant + 2 * pack + wire(
+            g.payload * (1 + g.delta_bits / 8) + scales, 3),
     }
 
 
 def _value_kind(regime: str) -> str:
     if regime == "dense":
         return "dense"
+    if regime.startswith("int4"):
+        return "i4"
     if regime.startswith("int8"):
         return "i8"
     if regime.startswith("fp16"):
@@ -269,6 +353,12 @@ def _value_kind(regime: str) -> str:
 
 def _is_packed(regime: str) -> bool:
     return regime.endswith("_packed")
+
+
+def _uses_words(regime: str) -> bool:
+    """Whether a regime's indices ride the shared uint32 words lane
+    (bit-packed or Elias-Fano) instead of the plain-offset lane."""
+    return regime.endswith("_packed") or regime == "int8_delta_idx"
 
 
 class Plan:
@@ -336,11 +426,13 @@ class Plan:
             return 0
         kinds = {_value_kind(r) for r in sp}
         lanes = 0
-        lanes += 1 if ("f32" in kinds or "i8" in kinds) else 0  # f32 lane
+        # f32 lane: fp32 values and/or the int8 row scales / int4
+        # bucket scales appended to it
+        lanes += 1 if kinds & {"f32", "i8", "i4"} else 0
         lanes += 1 if "f16" in kinds else 0
-        lanes += 1 if "i8" in kinds else 0                       # q lane
-        lanes += 1 if any(not _is_packed(r) for r in sp) else 0  # idx
-        lanes += 1 if any(_is_packed(r) for r in sp) else 0      # words
+        lanes += 1 if kinds & {"i8", "i4"} else 0                # q lane
+        lanes += 1 if any(not _uses_words(r) for r in sp) else 0  # idx
+        lanes += 1 if any(_uses_words(r) for r in sp) else 0      # words
         return lanes
 
     def collectives(self, dense_reduces: int = 1) -> Dict[str, int]:

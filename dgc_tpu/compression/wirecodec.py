@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["IndexCodec"]
+__all__ = ["IndexCodec", "DeltaIndexCodec", "pack_int4", "unpack_int4"]
 
 
 class IndexCodec:
@@ -138,3 +138,204 @@ class IndexCodec:
         local = (lo | hi) & jnp.asarray(self._mask)
         return (jnp.asarray(self.slot_off, out_dtype)
                 + local.astype(out_dtype))
+
+
+class DeltaIndexCodec:
+    """Elias-Fano packing of the canonically SORTED index stream.
+
+    The ``int8_delta_idx`` regime's index lane: per delta bucket with a
+    static universe ``U = rows * cols`` (the bucket's grid span) and
+    payload ``p``, each bucket-local position ``g = idx - base`` splits
+    into ``s = max(0, floor(log2(U / p)))`` fixed-width low bits plus a
+    unary-coded high part — the textbook Elias-Fano layout, which IS
+    delta-then-bitpack: the high bitvector sets bit ``high_j + j``, i.e.
+    it unary-codes the deltas of the high parts over the sorted order.
+    Total wire size is a compile-time constant (``p*s`` low bits +
+    ``p + (U >> s) + 1`` high bits per bucket, each region padded to
+    whole uint32 words) — near the information-theoretic
+    ``log2(C(U, p))`` bound, ~``s + 2`` bits/index worst case vs the
+    ``ceil(log2 numel)`` of :class:`IndexCodec`.
+
+    CONTRACT: ``encode`` input must be sorted ascending by canonical
+    position *within each bucket* (the engine sorts each delta bucket's
+    payload slice — values and indices together — before any lane
+    packing; rows occupy disjoint ascending ranges and canonicalization
+    clips in-row, so the sort never moves a slot across rows and every
+    static per-row structure stays valid). Unsorted input packs colliding
+    high bits (add carries) and decodes to garbage, which the receiver's
+    per-slot row clamp then contains — same failure envelope as a
+    corrupted wire word.
+
+    Decode is vectorized (no sequential scan): extract the ``Hb`` high
+    bits, build ``key_t = t`` for set bits / ``t + Hb`` for clear bits,
+    sort ascending — the first ``p`` sorted keys are the set-bit
+    positions in order, and ``high_j = pos_j - j``.
+    """
+
+    def __init__(self, buckets):
+        offs, numels = [], []
+        self.meta = []            # per-bucket static layout
+        self.bucket_words = []    # per-bucket uint32 word counts
+        word0 = 0
+        for b in buckets:
+            rows = np.asarray(b.tight) // b.max_sel
+            offs.append(np.asarray(b.row_offsets, np.int64)[rows])
+            numels.append(np.asarray(b.numels, np.int64)[rows])
+            U = int(b.rows) * int(b.cols)
+            p = int(b.payload)
+            if U >= 2 ** 31:
+                # decoded positions ride int32 arithmetic; a >2^31-slot
+                # grid cannot — plan such buckets int8_packed/plain
+                raise ValueError(
+                    "int8_delta_idx: bucket grid spans "
+                    f"{U} >= 2^31 slots — exceeds the int32 Elias-Fano "
+                    "decode; use int8_packed for this bucket")
+            s = max(0, int(math_floor_log2(U // max(p, 1))))
+            lw = -(-(p * s) // 32)
+            Hb = p + (U >> s) + 1
+            hw = -(-Hb // 32)
+            self.meta.append({
+                "base": int(b.base), "U": U, "p": p, "s": s, "Hb": Hb,
+                "low_w0": word0, "low_words": lw,
+                "high_w0": word0 + lw, "high_words": hw})
+            self.bucket_words.append(lw + hw)
+            word0 += lw + hw
+        if offs:
+            self.slot_off = np.concatenate(offs)
+            self.slot_numel = np.concatenate(numels)
+        else:
+            self.slot_off = np.zeros(0, np.int64)
+            self.slot_numel = np.ones(0, np.int64)
+        self.payload = int(self.slot_off.shape[0])
+        self.nwords = word0
+        self.total_bits = sum(m["p"] * m["s"] + m["Hb"]
+                              for m in self.meta)
+
+    @property
+    def bits_per_index(self) -> float:
+        return self.total_bits / self.payload if self.payload else 0.0
+
+    def canonical(self, indices: jax.Array) -> jax.Array:
+        """The decode fixed point for sorted input: each index clipped
+        into its slot's owning row (same contract as
+        :meth:`IndexCodec.canonical` — padded sentinel-carrying slots
+        clip to an arbitrary in-row position whose wire value is 0.0)."""
+        off = jnp.asarray(self.slot_off, indices.dtype)
+        hi_lim = jnp.asarray(self.slot_numel - 1, indices.dtype)
+        return off + jnp.clip(indices - off, 0, hi_lim)
+
+    def encode(self, indices: jax.Array) -> jax.Array:
+        """[payload] global flat indices (sorted per bucket by canonical
+        position) -> [nwords] uint32 Elias-Fano stream."""
+        if not self.payload:
+            return jnp.zeros((0,), jnp.uint32)
+        canon = self.canonical(indices)
+        # +1 spill guard word, same construction as IndexCodec.encode:
+        # a slot whose low-bit range ends exactly at its region boundary
+        # contributes a zero spill there, so cross-region adds are no-ops
+        words = jnp.zeros((self.nwords + 1,), jnp.uint32)
+        p0 = 0
+        for m in self.meta:
+            p, s = m["p"], m["s"]
+            g = (canon[p0:p0 + p] - m["base"]).astype(jnp.uint32)
+            high = g >> s
+            if s > 0:
+                low = g & jnp.uint32((1 << s) - 1)
+                bit_off = np.arange(p, dtype=np.int64) * s
+                w0 = jnp.asarray(m["low_w0"] + (bit_off >> 5), jnp.int32)
+                shift = jnp.asarray(bit_off & 31, jnp.uint32)
+                lo = low << shift
+                spill = jnp.where(shift > 0, jnp.uint32(32) - shift,
+                                  jnp.uint32(31))
+                hi = jnp.where(shift > 0, low >> spill, jnp.uint32(0))
+                words = words.at[w0].add(lo).at[w0 + 1].add(hi)
+            # high part: set bit (high_j + j) — strictly increasing for
+            # sorted input, so distinct (word, bit) pairs and add == or
+            pos = (high.astype(jnp.int32)
+                   + jnp.arange(p, dtype=jnp.int32))
+            pos = jnp.clip(pos, 0, m["Hb"] - 1)
+            w = m["high_w0"] + (pos >> 5)
+            bit = (pos & 31).astype(jnp.uint32)
+            words = words.at[w].add(jnp.uint32(1) << bit)
+            p0 += p
+        return words[:self.nwords]
+
+    def decode(self, words: jax.Array,
+               out_dtype=jnp.int32) -> jax.Array:
+        """[..., nwords] uint32 -> [..., payload] global flat indices
+        (the canonical sorted stream). Vectorized over leading axes."""
+        if not self.payload:
+            return jnp.zeros(words.shape[:-1] + (0,), out_dtype)
+        parts = []
+        for m in self.meta:
+            p, s, Hb = m["p"], m["s"], m["Hb"]
+            hwords = jax.lax.slice_in_dim(
+                words, m["high_w0"], m["high_w0"] + m["high_words"],
+                axis=-1)
+            t = np.arange(Hb, dtype=np.int64)
+            bits = ((jnp.take(hwords, jnp.asarray(t >> 5, jnp.int32),
+                              axis=-1)
+                     >> jnp.asarray(t & 31, jnp.uint32)) & jnp.uint32(1))
+            # sort-key trick: set bits keep their position t, clear bits
+            # are pushed past Hb; the first p sorted keys are the set-bit
+            # positions in ascending order
+            key = jnp.where(bits.astype(bool),
+                            jnp.asarray(t, jnp.int32),
+                            jnp.asarray(t + Hb, jnp.int32))
+            pos = jax.lax.slice_in_dim(jnp.sort(key, axis=-1), 0, p,
+                                       axis=-1)
+            high = pos - jnp.arange(p, dtype=jnp.int32)
+            if s > 0:
+                lw = jax.lax.slice_in_dim(
+                    words, m["low_w0"], m["low_w0"] + m["low_words"],
+                    axis=-1)
+                pad = jnp.zeros(lw.shape[:-1] + (1,), jnp.uint32)
+                lpad = jnp.concatenate([lw, pad], axis=-1)
+                bit_off = np.arange(p, dtype=np.int64) * s
+                w0 = jnp.asarray(bit_off >> 5, jnp.int32)
+                shift = jnp.asarray(bit_off & 31, jnp.uint32)
+                lo = jnp.take(lpad, w0, axis=-1) >> shift
+                spill = jnp.where(shift > 0, jnp.uint32(32) - shift,
+                                  jnp.uint32(31))
+                hi_w = jnp.take(lpad, w0 + 1, axis=-1)
+                hi = jnp.where(shift > 0, hi_w << spill, jnp.uint32(0))
+                low = (lo | hi) & jnp.uint32((1 << s) - 1)
+                # int32 is enough: the constructor rejects U >= 2^31,
+                # and high << s | low < U
+                g = ((high.astype(jnp.int32) << s)
+                     | low.astype(jnp.int32)).astype(out_dtype)
+            else:
+                g = high.astype(out_dtype)
+            parts.append(g + jnp.asarray(m["base"], out_dtype))
+        return (parts[0] if len(parts) == 1
+                else jnp.concatenate(parts, axis=-1))
+
+
+def math_floor_log2(n: int) -> int:
+    """floor(log2(n)) for n >= 1 (0 for n < 1), exact integer math —
+    ``math.log2`` rounds 2^53-scale inputs."""
+    return max(int(n), 1).bit_length() - 1
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """[n] integer nibbles in [-8, 7] -> [ceil(n/2)] int8, two per byte
+    (even slot = low nibble). Odd payloads pad one zero nibble."""
+    n = q.shape[0]
+    q = q.astype(jnp.int32)
+    if n % 2:
+        q = jnp.concatenate([q, jnp.zeros((1,), jnp.int32)])
+    lo = q[0::2] & 15
+    hi = q[1::2] & 15
+    return jax.lax.bitcast_convert_type(
+        (lo | (hi << 4)).astype(jnp.uint8), jnp.int8)
+
+
+def unpack_int4(b: jax.Array, n: int) -> jax.Array:
+    """[..., ceil(n/2)] int8 nibble bytes -> [..., n] int32 in [-8, 7]
+    (sign-extended). Vectorized over leading axes."""
+    u = jax.lax.bitcast_convert_type(b, jnp.uint8).astype(jnp.int32)
+    lo = u & 15
+    hi = (u >> 4) & 15
+    nib = jnp.stack([lo, hi], axis=-1).reshape(b.shape[:-1] + (-1,))
+    nib = jax.lax.slice_in_dim(nib, 0, n, axis=-1)
+    return nib - 16 * (nib >= 8).astype(jnp.int32)

@@ -140,6 +140,11 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
                "under the exchange planner (bench.py "
                "planned.ici_v5e8.ratio) — the never-lose gate: the "
                "planner must keep this >= ~1.0", better="higher"),
+    MetricSpec("eth_planned_ratio", "scalar",
+               "dense/planned exchange-time ratio on the 32x25GbE "
+               "reference fabric under the exchange planner (bench.py "
+               "planned.32x25GbE.ratio) — the win-by-more gate: the "
+               "low-bit codec menu must not regress it", better="higher"),
     MetricSpec("worker_skew", "scalar",
                "median per-step relative cross-worker dispersion from the "
                "fleet taps (bench.py fleet.worker_skew)", better="lower"),

@@ -65,6 +65,10 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
         if isinstance(pici, dict) and isinstance(pici.get("ratio"),
                                                  (int, float)):
             out["ici_planned_ratio"] = float(pici["ratio"])
+        peth = planned.get("32x25GbE")
+        if isinstance(peth, dict) and isinstance(peth.get("ratio"),
+                                                 (int, float)):
+            out["eth_planned_ratio"] = float(peth["ratio"])
     # fleet dispersion medians (lower is better; see registry)
     flt = obj.get("fleet")
     if isinstance(flt, dict):

@@ -199,6 +199,9 @@ def parent(args):
             pts.append((2 * 4 * r["P"] * (Wk - 1) / Wk, r["dense_ms"]))
             pts.append(((Wk - 1) * r["K"] * 8, r["sparse_ms"]))
         alpha_ms, gbps = fit_link_model(pts)
+        beta = 1.0 / (gbps * 1e6)
+        residual = (sum((t - (alpha_ms + b * beta)) ** 2
+                        for b, t in pts) / len(pts)) ** 0.5
         fabric = {
             "schema": FABRIC_SCHEMA, "version": FABRIC_VERSION,
             "name": f"measured-{Wk}w-gloo",
@@ -206,6 +209,19 @@ def parent(args):
             "rows": result["rows"],
             "fit": {"alpha_ms": round(alpha_ms, 6),
                     "gbps": round(gbps, 6)},
+            # same stamp shape as the autotuner's runs/fabric.json
+            # (compression/autotune.py) so downstream tooling can tell
+            # the two producers — and their fit quality — apart
+            "provenance": {
+                "source": "measure_exchange",
+                "geometries": [r["name"] for r in result["rows"]],
+                "points": len(pts),
+                "distinct_sizes": len({int(b) for b, _ in pts}),
+                "geometry_bytes": sorted({int(b) for b, _ in pts}),
+                "fit_residual_ms": round(residual, 6),
+                "iters": args.iters,
+                "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            },
         }
         d = os.path.dirname(os.path.abspath(args.fabric_out))
         os.makedirs(d, exist_ok=True)

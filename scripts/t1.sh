@@ -43,6 +43,14 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "PLANNER_SMOKE=ok" || { echo "PLANNER_SMOKE=FAIL"; rc=1; }
+# autotune smoke (docs/PLANNER.md §Autotuning): a real 2-epoch
+# `train.py --autotune` subprocess on the 8-device CPU mesh — must refit
+# the link model at each epoch boundary, record autotune_replan events in
+# the telemetry stream, and leave a valid provenance-stamped fabric.json
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
+  "tests/test_cli.py::test_cli_autotune_two_epoch_replan" \
+  -q -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "AUTOTUNE_SMOKE=ok" || { echo "AUTOTUNE_SMOKE=FAIL"; rc=1; }
 # fleet monitor smoke (docs/TELEMETRY.md §Fleet monitoring): registry fleet
 # schema, the packed in-graph gather's straggler verdict, tolerant shard
 # readers + multi-host merge, rolling-band desync detector, and the

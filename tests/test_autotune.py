@@ -1,0 +1,213 @@
+"""Online exchange replanning (dgc_tpu.compression.autotune): the
+epoch-boundary refit loop, its zero-recompile plan identity, and the
+provenance-stamped fabric.json persistence.
+
+Everything here is host-side (engine construction + planning is NumPy);
+no mesh, no compiled exchange — the compile-pinning side lives in
+dgc_tpu/analysis/suite.py as contracts.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu import DGCCompressor, DGCSGDMemory, DistributedOptimizer, dgc_sgd
+from dgc_tpu.compression.autotune import Autotuner, regime_histogram
+from dgc_tpu.compression.planner import (
+    BUILTIN_FABRICS,
+    Fabric,
+    load_fabric,
+)
+from dgc_tpu.utils.pytree import named_flatten
+
+W = 8
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write_record(self, rec):
+        self.records.append(rec)
+
+
+def _engine(ratio=0.05):
+    """A two-bucket engine (one large, one small tensor) whose plan
+    flips between sparse and dense regimes as the modeled link speed
+    changes — the replan trigger geometry."""
+    rng = np.random.RandomState(0)
+    params = {
+        "big": {"kernel": jnp.asarray(rng.randn(600, 600), jnp.float32)},
+        "small": {"kernel": jnp.asarray(rng.randn(40, 50), jnp.float32)},
+        "bias": {"b": jnp.asarray(rng.randn(16), jnp.float32)},
+    }
+    named, _ = named_flatten(params)
+    comp = DGCCompressor(ratio, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=1.0)
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    _, engine = dist.make_flat(params)
+    return engine
+
+
+def _selfconsistent_points(fabric, sizes):
+    """Per-hop (bytes, ms) points exactly on the fabric's own line —
+    a refit from these recovers (alpha_ms, gbps) and the plan key
+    cannot change."""
+    return [(b, fabric.alpha_ms + b / (fabric.gbps * 1e6)) for b in sizes]
+
+
+def test_regime_histogram():
+    assert regime_histogram(()) == {}
+    assert regime_histogram(("int8", "dense", "int8", "int4_packed")) == {
+        "dense": 1, "int4_packed": 1, "int8": 2}
+    # stable (sorted) key order for JSON diffing
+    assert list(regime_histogram(("fp32", "dense"))) == ["dense", "fp32"]
+
+
+def test_autotuner_stable_name_and_gating():
+    """The fabric renames to autotuned-<base> ONCE, so Plan.key() moves
+    only with the regimes; below min_points epoch_end is a no-op."""
+    tuner = Autotuner(fabric="32x25GbE", world=W, min_points=3)
+    assert tuner.fabric.name == "autotuned-32x25GbE"
+    assert tuner.base_name == "32x25GbE"
+    assert tuner.world == W
+    # renaming is idempotent: an already-autotuned fabric keeps its name
+    again = Autotuner(fabric=tuner.fabric, world=W)
+    assert again.fabric.name == "autotuned-32x25GbE"
+
+    engine = _engine()
+    plan = tuner.plan_for(engine)
+    assert plan.fabric.name == "autotuned-32x25GbE"
+    assert tuner.plan is plan
+
+    # 2 points < min_points=3: no fit, no event, compiled step untouched
+    tuner.sink = _ListSink()
+    tuner.record_step(1.0, 10_000)
+    tuner.record_step(1.1, 10_000)
+    assert tuner.epoch_end(engine, epoch=0) is None
+    assert tuner.refit_count == 0 and tuner.replan_count == 0
+    assert tuner.sink.records == []
+    # non-positive samples never enter the pool
+    tuner.record_step(0.0, 10_000)
+    tuner.record_step(1.0, 0)
+    assert len(tuner.points) == 2
+
+
+def test_autotuner_refit_same_key_keeps_plan():
+    """Self-consistent points: the refit recovers the fabric it already
+    had, the plan key is unchanged, epoch_end returns None (the
+    caller's do-not-rebuild signal) — but the refit IS recorded."""
+    tuner = Autotuner(fabric="32x25GbE", world=W, min_points=2,
+                      sink=_ListSink())
+    engine = _engine()
+    plan0 = tuner.plan_for(engine)
+    for b, t in _selfconsistent_points(tuner.fabric,
+                                       (1e4, 1e5, 1e6, 5e6)):
+        tuner.record_step(t, int(b))
+    assert tuner.epoch_end(engine, epoch=1) is None
+    assert tuner.refit_count == 1
+    assert tuner.replan_count == 0
+    assert tuner.plan is plan0
+    assert tuner.fabric.measured
+    assert tuner.fabric.gbps == pytest.approx(
+        BUILTIN_FABRICS["32x25GbE"].gbps, rel=1e-6)
+    (rec,) = tuner.sink.records
+    assert rec["event"] == "autotune_replan"
+    assert rec["rebuilt"] is False
+    assert rec["epoch"] == 1
+    assert rec["regimes"] == regime_histogram(plan0.regimes)
+
+
+def test_autotuner_replans_when_fabric_drifts():
+    """Start on the fast ICI fabric (all-dense plan), then feed points
+    from a link ~1000x slower: the refit must change the regimes, and
+    epoch_end returns the new plan exactly once."""
+    tuner = Autotuner(fabric="ici_v5e8", world=W, min_points=2,
+                      sink=_ListSink())
+    engine = _engine()
+    plan0 = tuner.plan_for(engine)
+    assert plan0.all_dense, plan0.regimes
+    slow = Fabric("slow", W, gbps=0.05, alpha_ms=5.0)
+    for b, t in _selfconsistent_points(slow, (1e4, 1e5, 1e6, 5e6)):
+        tuner.record_step(t, int(b))
+    new = tuner.epoch_end(engine, epoch=2)
+    assert new is not None and not new.all_dense
+    assert tuner.replan_count == 1
+    assert tuner.plan is new
+    # the key moved through the regimes, never the name
+    assert new.fabric.name == "autotuned-ici_v5e8"
+    assert new.key() != plan0.key()
+    (rec,) = tuner.sink.records
+    assert rec["rebuilt"] is True
+    # a second epoch on the same points: same decisions, no rebuild
+    assert tuner.epoch_end(engine, epoch=3) is None
+    assert tuner.refit_count == 2 and tuner.replan_count == 1
+
+
+def test_autotuner_writes_provenance_stamped_fabric(tmp_path):
+    """fabric.json round-trips through planner.load_fabric (schema,
+    name, workers, fit) and carries the autotune provenance block."""
+    out = tmp_path / "runs" / "fabric.json"
+    tuner = Autotuner(fabric="32x25GbE", world=W, min_points=2,
+                      fabric_out=str(out))
+    engine = _engine()
+    tuner.plan_for(engine)
+    for b, t in _selfconsistent_points(tuner.fabric, (1e5, 1e6, 4e6)):
+        tuner.record_step(t, int(b))
+    tuner.epoch_end(engine, epoch=5)
+    fab = load_fabric(str(out))
+    assert fab.name == "autotuned-32x25GbE"
+    assert fab.workers == W
+    assert fab.measured
+    assert fab.gbps == pytest.approx(tuner.fabric.gbps)
+    assert fab.alpha_ms == pytest.approx(tuner.fabric.alpha_ms)
+    prov = json.loads(out.read_text())["provenance"]
+    assert prov["source"] == "autotune"
+    assert prov["base"] == "32x25GbE"
+    assert prov["refit"] == 1
+    assert prov["epoch"] == 5
+    assert prov["points"] == 3
+    assert prov["distinct_sizes"] == 3
+    assert prov["geometry_bytes"] == [100_000, 1_000_000, 4_000_000]
+    # self-consistent points lie exactly on the fit line
+    assert prov["fit_residual_ms"] == pytest.approx(0.0, abs=1e-9)
+    assert "written_at" in prov
+
+
+def test_autotuner_ingests_attrib_profile():
+    """Per-bucket allgather ms from an attrib profile dict become
+    (bucket wire bytes, ms) points — the sharp multi-size input."""
+    tuner = Autotuner(fabric="32x25GbE", world=W, min_points=2)
+    engine = _engine()
+    tuner.plan_for(engine)
+    wire = engine.bucket_wire_bytes()
+    assert len(wire) == 2 and all(b > 0 for b in wire)
+    profile = {"dgc": {"buckets": {
+        "b0": {"allgather": 1.5, "select": 0.3},
+        "b1": {"allgather": 0.2},
+        "b7": {"allgather": 9.9},      # no such bucket: ignored
+    }}}
+    assert tuner.add_profile(profile, engine) == 2
+    assert sorted(tuner.points) == sorted(
+        [(float(wire[0]), 1.5), (float(wire[1]), 0.2)])
+    assert tuner.add_profile(None, engine) == 0
+    assert tuner.add_profile({}, engine) == 0
+    # epoch_end ingests the profile= kwarg the same way
+    tuner2 = Autotuner(fabric="32x25GbE", world=W, min_points=2)
+    tuner2.plan_for(engine)
+    tuner2.epoch_end(engine, epoch=0, profile=profile)
+    assert tuner2.refit_count == 1
+
+
+def test_autotuner_point_pool_is_bounded():
+    tuner = Autotuner(fabric="32x25GbE", world=W, max_points=10)
+    for i in range(25):
+        tuner.record_step(1.0 + i, 1000 + i)
+    assert len(tuner.points) == 10
+    # newest kept
+    assert tuner.points[-1] == (1024.0, 25.0)
+    assert tuner.points[0] == (1015.0, 16.0)

@@ -4,6 +4,8 @@ fake 8-device CPU mesh. This is the only coverage of train.py's __main__
 path (argument parsing, config composition, save-path naming, the epoch
 loop, resume arithmetic)."""
 
+import glob
+import json
 import os
 import shutil
 import subprocess
@@ -58,3 +60,60 @@ def test_cli_train_resume_evaluate(run_dir):
     assert r.returncode == 0, r.stderr[-2000:]
     assert "acc/test_top1" in r.stdout
     assert "training epoch" not in r.stdout
+
+
+def test_cli_autotune_two_epoch_replan():
+    """The AUTOTUNE_SMOKE gate (scripts/t1.sh): a 2-epoch --autotune run
+    must refit at every epoch boundary, record an autotune_replan event
+    in the telemetry stream, and leave a valid provenance-stamped
+    fabric.json in the save path."""
+    suffix = f".atsmoke{os.getpid()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    cmd = [sys.executable, "train.py",
+           "--configs", "configs/cifar/resnet20.py", "configs/dgc/wm5.py",
+           "configs/telemetry.py",
+           "--cpu_mesh", "8", "--suffix", suffix,
+           "--dataset.synthetic_size", "128", "--train.batch_size", "2",
+           "--train.num_epochs", "2", "--autotune"]
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=900)
+    dirs = glob.glob(os.path.join(REPO, "runs", f"*{suffix}*"))
+    try:
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        assert "[autotune] fabric autotuned-" in r.stdout
+        assert "[autotune] refit" in r.stdout
+        assert len(dirs) == 1, dirs
+
+        # the refreshed fabric.json round-trips through the planner
+        sys.path.insert(0, REPO)
+        from dgc_tpu.compression.planner import load_fabric
+        fpath = os.path.join(dirs[0], "fabric.json")
+        fab = load_fabric(fpath)
+        assert fab.name.startswith("autotuned-")
+        assert fab.measured and fab.gbps > 0
+        with open(fpath) as fh:
+            prov = json.load(fh)["provenance"]
+        assert prov["source"] == "autotune"
+        assert prov["refit"] >= 1 and prov["points"] >= 2
+
+        # the replan event rode the telemetry stream (one per refit)
+        events = []
+        for p in glob.glob(os.path.join(dirs[0], "telemetry", "*.jsonl")):
+            with open(p) as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "autotune_replan":
+                        events.append(rec)
+        assert events, "no autotune_replan event in the telemetry stream"
+        for rec in events:
+            assert rec["points"] >= 2
+            assert rec["gbps"] > 0
+            assert isinstance(rec["regimes"], dict)
+            assert rec["rebuilt"] in (True, False)
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)

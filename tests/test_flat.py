@@ -1263,6 +1263,296 @@ def test_index_codec_boundary_values():
     np.testing.assert_array_equal(back, idx)
 
 
+# ------------------------------------------------------------------ #
+# delta-coded (Elias-Fano) index wire + int4 nibble packing          #
+# (compression/wirecodec.py, the int8_delta_idx/int4_packed regimes) #
+# ------------------------------------------------------------------ #
+
+
+def _fake_bucket(base, cols, numels, num_selects):
+    """A bucket-shaped object with the flat engine's grid invariants:
+    row r spans [base + r*cols, base + r*cols + numel_r), numel_r <=
+    cols, tight payload layout over per-row quotas."""
+
+    class B:
+        pass
+
+    b = B()
+    b.base = int(base)
+    b.cols = int(cols)
+    b.rows = len(numels)
+    b.row_offsets = base + np.arange(b.rows, dtype=np.int64) * cols
+    b.numels = np.asarray(numels, np.int64)
+    ns = np.asarray(num_selects, np.int32)
+    b.num_selects = ns
+    b.max_sel = int(ns.max())
+    b.payload = int(ns.sum())
+    tight = [r * b.max_sel + k for r, n in enumerate(ns) for k in range(n)]
+    b.tight = np.asarray(tight, np.int64)
+    return b
+
+
+def _ef_encode_oracle(codec, gidx):
+    """Bit-by-bit NumPy Elias-Fano encoder: per bucket, slot j's low
+    ``s`` bits at bit offset ``j*s`` of the low region, high bit at
+    position ``high_j + j`` of the high region."""
+    words = np.zeros(codec.nwords, np.uint32)
+
+    def set_bit(t):
+        words[t >> 5] |= np.uint32(1) << np.uint32(t & 31)
+
+    canon = np.asarray(codec.canonical(jnp.asarray(gidx, jnp.int32)))
+    p0 = 0
+    for m in codec.meta:
+        p, s = m["p"], m["s"]
+        for j in range(p):
+            g = int(canon[p0 + j]) - m["base"]
+            for k in range(s):
+                if (g >> k) & 1:
+                    set_bit(m["low_w0"] * 32 + j * s + k)
+            set_bit(m["high_w0"] * 32 + (g >> s) + j)
+        p0 += p
+    return words
+
+
+def _sorted_bucket_indices(rng, bucket):
+    """Random in-row indices, sorted within the bucket — the engine's
+    pre-encode contract (``_sort_delta_payload``). Rows occupy disjoint
+    ascending flat ranges, so the global sort lands each row's indices
+    exactly on that row's payload slots."""
+    rows = np.asarray(bucket.tight) // bucket.max_sel
+    out = [int(bucket.row_offsets[r]) + rng.randint(0, int(bucket.numels[r]))
+           for r in rows]
+    return np.sort(np.asarray(out, np.int64))
+
+
+def test_delta_index_codec_roundtrip_edges():
+    """Elias-Fano round-trip at the edge geometries: a 1-row bucket, a
+    payload == full-grid bucket (s == 0, high-bits-only), a deep-s
+    sparse bucket, and a multi-bucket stream with offset bases and
+    ragged numels. Indices are bitwise-exact against the input and the
+    packed words bitwise-exact against a NumPy bit-by-bit oracle."""
+    from dgc_tpu.compression.wirecodec import DeltaIndexCodec
+
+    geometries = [
+        # one row, modest sparsity
+        [_fake_bucket(0, 64, [50], [5])],
+        # max_sel == cols: every grid slot selected, p == U forces s=0
+        [_fake_bucket(0, 4, [4, 4], [4, 4])],
+        # deep s: 300k-slot grid, 21 selected -> 13 low bits per index
+        [_fake_bucket(0, 100_000, [99_997, 100_000, 12_345], [7, 5, 9])],
+        # two buckets, second base far from zero, ragged numels
+        [_fake_bucket(0, 128, [100, 128, 3], [6, 6, 2]),
+         _fake_bucket(4096, 512, [500], [17])],
+    ]
+    rng = np.random.RandomState(7)
+    for buckets in geometries:
+        codec = DeltaIndexCodec(buckets)
+        assert codec.payload == sum(b.payload for b in buckets)
+        assert codec.nwords == sum(codec.bucket_words)
+        for _ in range(3):
+            gidx = np.concatenate([_sorted_bucket_indices(rng, b)
+                                   for b in buckets])
+            words = np.asarray(
+                jax.jit(codec.encode)(jnp.asarray(gidx, jnp.int32)))
+            assert words.dtype == np.uint32
+            assert words.shape == (codec.nwords,)
+            np.testing.assert_array_equal(
+                words, _ef_encode_oracle(codec, gidx),
+                err_msg="wire words differ from the NumPy oracle")
+            back = np.asarray(jax.jit(codec.decode)(
+                jnp.asarray(words, jnp.uint32)))
+            np.testing.assert_array_equal(back, gidx)
+        # batched decode (the gathered [W, nwords] wire)
+        gidx_w = np.stack([np.concatenate(
+            [_sorted_bucket_indices(rng, b) for b in buckets])
+            for _ in range(W)])
+        words_w = jnp.stack([codec.encode(jnp.asarray(gidx_w[w], jnp.int32))
+                             for w in range(W)])
+        back_w = np.asarray(jax.jit(codec.decode)(words_w))
+        np.testing.assert_array_equal(back_w, gidx_w)
+
+
+def test_delta_index_codec_all_pad_bucket():
+    """All-structural-pad bucket: every payload slot carries the global
+    scatter sentinel (no threshold passers). The wire must decode to
+    the CANONICAL stream — each sentinel clipped to its row's last
+    element — which is the decode(encode(x)) fixed point the engine's
+    0.0-valued pad slots ride safely."""
+    from dgc_tpu.compression.wirecodec import DeltaIndexCodec
+
+    b = _fake_bucket(256, 32, [20, 7, 32], [4, 4, 4])
+    codec = DeltaIndexCodec([b])
+    sentinel = 10 ** 6  # far outside every row
+    gidx = np.full(b.payload, sentinel, np.int64)
+    canon = np.asarray(codec.canonical(jnp.asarray(gidx, jnp.int32)))
+    # clipped-to-row-end positions are nondecreasing across the tight
+    # layout, so the sorted-input contract already holds
+    assert np.all(np.diff(canon) >= 0)
+    words = codec.encode(jnp.asarray(gidx, jnp.int32))
+    back = np.asarray(codec.decode(words))
+    np.testing.assert_array_equal(back, canon)
+    np.testing.assert_array_equal(
+        np.asarray(words), _ef_encode_oracle(codec, gidx))
+
+
+def test_delta_index_codec_rejects_oversized_universe():
+    from dgc_tpu.compression.wirecodec import DeltaIndexCodec
+
+    b = _fake_bucket(0, 2 ** 30, [2 ** 30, 2 ** 30], [1, 1])
+    with pytest.raises(ValueError, match="2\\^31"):
+        DeltaIndexCodec([b])
+
+
+def test_int4_pack_unpack_oracle():
+    """Two-nibbles-per-byte packing round-trips every value in [-8, 7]
+    at odd and even lengths, matches a NumPy byte oracle, and unpacks
+    batched (the gathered [W, nbytes] wire)."""
+    from dgc_tpu.compression.wirecodec import pack_int4, unpack_int4
+
+    rng = np.random.RandomState(3)
+    for n in (1, 2, 7, 8, 33):
+        q = rng.randint(-8, 8, size=n).astype(np.int32)
+        packed = np.asarray(jax.jit(pack_int4)(jnp.asarray(q)))
+        assert packed.dtype == np.int8
+        assert packed.shape == ((n + 1) // 2,)
+        # byte oracle: even slot = low nibble, odd = high, zero pad
+        qp = np.concatenate([q, np.zeros(n % 2, np.int32)])
+        oracle = ((qp[0::2] & 15) | ((qp[1::2] & 15) << 4)).astype(
+            np.uint8).view(np.int8)
+        np.testing.assert_array_equal(packed, oracle)
+        back = np.asarray(unpack_int4(jnp.asarray(packed), n))
+        np.testing.assert_array_equal(back, q)
+    # full nibble range survives sign-extension
+    q = np.arange(-8, 8, dtype=np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_int4(pack_int4(jnp.asarray(q)), 16)), q)
+    # batched leading axis
+    qw = rng.randint(-8, 8, size=(W, 9)).astype(np.int32)
+    pw = jnp.stack([pack_int4(jnp.asarray(qw[w])) for w in range(W)])
+    np.testing.assert_array_equal(np.asarray(unpack_int4(pw, 9)), qw)
+
+
+def test_flat_delta_idx_bitwise_matches_int8(mesh8):
+    """int8_delta_idx is int8 plus a different index wire: the decoded
+    exchange and memory state must equal the int8 plan's BITWISE —
+    the per-bucket payload sort permutes (value, index) pairs together
+    and scatter-add is order-invariant over disjoint canonical slots."""
+    from dgc_tpu.compression.flat import FlatDGCEngine, ParamLayout
+    from dgc_tpu.compression.planner import BUILTIN_FABRICS, Plan
+
+    params = _params()
+    named, _ = named_flatten(params)
+    compressed = [n for n, p in named.items() if p.ndim > 1]
+    layout = ParamLayout(params, compressed)
+    fab = BUILTIN_FABRICS["32x25GbE"]
+
+    def build(regime):
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                    world_size=W)
+        nb = len(FlatDGCEngine(comp, layout).buckets)
+        engine = FlatDGCEngine(comp, layout,
+                               plan=Plan((regime,) * nb, fab, W))
+        return engine, _flat_exchange_fn(dist, engine, mesh8)
+
+    eng_d, fn_d = build("int8_delta_idx")
+    eng_8, fn_8 = build("int8")
+    # the delta wire must actually be smaller than the int32-index int8
+    # wire (that is the whole point of the regime)
+    assert eng_d.wire_bytes_per_worker() < eng_8.wire_bytes_per_worker()
+    # and lane-exact per bucket: the per-bucket split sums to the total
+    assert sum(eng_d.bucket_wire_bytes()) == eng_d.wire_bytes_per_worker()
+
+    rng = np.random.RandomState(5)
+    g = rng.randn(W, layout.total).astype(np.float32)
+    covered = np.zeros((layout.total,), bool)
+    for n in layout.names:
+        covered[layout.offsets[n]:layout.offsets[n] + layout.sizes[n]] = True
+    g[:, ~covered] = 0.0
+    fg = jnp.asarray(g)
+
+    def init_mem(engine):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+            engine.init_memory())
+
+    mem_d, mem_8 = init_mem(eng_d), init_mem(eng_8)
+    for step in range(3):
+        key = jax.random.PRNGKey(step)
+        out_d, mem_d = fn_d(fg, mem_d, key)
+        out_8, mem_8 = fn_8(fg, mem_8, key)
+        np.testing.assert_array_equal(np.asarray(out_d[0]),
+                                      np.asarray(out_8[0]),
+                                      err_msg=f"step {step}")
+        fd = _mem_full(eng_d, mem_d, w=0)
+        f8 = _mem_full(eng_8, mem_8, w=0)
+        for mk in ("momentums", "velocities"):
+            np.testing.assert_array_equal(fd[mk], f8[mk],
+                                          err_msg=f"{mk} step {step}")
+
+
+def test_flat_int4_plan_tracks_fp32(mesh8):
+    """int4_packed: per-bucket scale/7 quantization bounds each
+    worker's per-value error by scale/2, so the W-worker sum stays
+    within W/14 of the fp32 exchange's dynamic range — and the wire is
+    smaller than the int8 regime's."""
+    from dgc_tpu.compression.flat import FlatDGCEngine, ParamLayout
+    from dgc_tpu.compression.planner import BUILTIN_FABRICS, Plan
+
+    params = _params()
+    named, _ = named_flatten(params)
+    compressed = [n for n, p in named.items() if p.ndim > 1]
+    layout = ParamLayout(params, compressed)
+    fab = BUILTIN_FABRICS["32x25GbE"]
+
+    def build(regime):
+        comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                             sample_ratio=1.0)
+        comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+        dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                    world_size=W)
+        nb = len(FlatDGCEngine(comp, layout).buckets)
+        engine = FlatDGCEngine(comp, layout,
+                               plan=Plan((regime,) * nb, fab, W))
+        return engine, _flat_exchange_fn(dist, engine, mesh8)
+
+    eng_4, fn_4 = build("int4_packed")
+    eng_f, fn_f = build("fp32")
+    eng_8, _ = build("int8")
+    assert eng_4.wire_bytes_per_worker() < eng_8.wire_bytes_per_worker()
+    assert sum(eng_4.bucket_wire_bytes()) == eng_4.wire_bytes_per_worker()
+
+    rng = np.random.RandomState(9)
+    g = rng.randn(W, layout.total).astype(np.float32)
+    covered = np.zeros((layout.total,), bool)
+    for n in layout.names:
+        covered[layout.offsets[n]:layout.offsets[n] + layout.sizes[n]] = True
+    g[:, ~covered] = 0.0
+    fg = jnp.asarray(g)
+
+    def init_mem(engine):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+            engine.init_memory())
+
+    mem_4, mem_f = init_mem(eng_4), init_mem(eng_f)
+    for step in range(2):
+        key = jax.random.PRNGKey(step)
+        out_4, mem_4 = fn_4(fg, mem_4, key)
+        out_f, mem_f = fn_f(fg, mem_f, key)
+        o4 = np.asarray(out_4[0])
+        of = np.asarray(out_f[0])
+        scale = np.abs(of).max()
+        d = np.abs(o4 - of)
+        # guaranteed per-value bound: W workers x scale/14 each
+        assert d.max() <= W / 14 * scale + 1e-6, (d.max(), scale)
+        # and quantization noise, not bias: tiny RMS over the buffer
+        assert np.sqrt(np.mean(d ** 2)) <= 0.05 * scale
+
+
 def test_flat_packed_indices_matches_unpacked(mesh8):
     """packed_indices=True (configs/dgc/packidx.py): the exchange result
     and memory state equal the int32-index wire's exactly — decoded

@@ -108,6 +108,14 @@ def main():
                              "reshard the per-worker DGC state "
                              "(docs/RESILIENCE.md §Elastic restart); same "
                              "as stacking configs/elastic.py")
+    parser.add_argument("--autotune", action="store_true",
+                        help="online exchange replanning: plan per-bucket "
+                             "wire regimes, refit the link model from "
+                             "measured step/bucket costs at epoch "
+                             "boundaries, and rebuild the step only when "
+                             "the plan key changes (docs/PLANNER.md "
+                             "§Autotuning); same as stacking "
+                             "configs/autotune.py")
     args, opts = parser.parse_known_args()
 
     if args.cpu_mesh or args.devices == "cpu":
@@ -322,7 +330,31 @@ def main():
         local_axis_name=mesh.axis_names[1] if num_local > 1 else None,
         local_size=num_local)
 
+    # online exchange replanning (configs/autotune.py or --autotune,
+    # docs/PLANNER.md §Autotuning): the engine gets a per-bucket regime
+    # plan up front; measured (bytes, ms) points refit the link model at
+    # epoch boundaries and the step is rebuilt ONLY when the plan key
+    # changes. Off = none of these paths run (byte-identical program).
+    atcfg = configs.train.get("autotune", None)
+    autotune_on = bool(args.autotune
+                       or (atcfg and atcfg.get("enabled", False)))
+    autotuner = None
+    if autotune_on and not configs.train.dgc:
+        raise SystemExit("--autotune plans the sparse DGC wire "
+                         "(configs with train.dgc = True)")
+
     flat_setup = make_flat_setup(variables, dist)
+    if autotune_on:
+        from dgc_tpu.compression.autotune import Autotuner
+        autotuner = Autotuner(
+            world=world,
+            fabric_out=os.path.join(configs.train.save_path, "fabric.json"),
+            min_points=int(atcfg.get("min_points", 2)) if atcfg else 2)
+        flat_setup = make_flat_setup(
+            variables, dist, plan=autotuner.plan_for(flat_setup.engine))
+        printr(f"[autotune] fabric {autotuner.fabric.name} "
+               f"({autotuner.fabric.gbps:.3g} GB/s) -> "
+               f"plan {list(flat_setup.engine.regimes)}")
     state = shard_state(make_flat_state(variables, dist, flat_setup, world,
                                         guards=guards_cfg),
                         mesh, axis, dist_opt=dist)
@@ -454,6 +486,10 @@ def main():
             guards=guards_cfg is not None, fleet=fleet_on)
         printr(f"[telemetry] -> {sink.path or '(non-coordinator)'}"
                + (" [fleet]" if fleet_on else ""))
+        if autotuner is not None:
+            # refit/replan events ride the telemetry stream (the
+            # AUTOTUNE_SMOKE gate and the monitor both read them there)
+            autotuner.sink = sink
         if elastic_pending is not None:
             # the restore resharded across a topology change: record it
             # in the telemetry stream so readers can re-anchor per-worker
@@ -521,6 +557,9 @@ def main():
     ############
 
     step_fn = None
+    autotune_pending = False     # a key()-changing replan awaits rebuild
+    at_prev = None               # previous dispatch stamp (autotune)
+    at_wire = 0                  # engine wire-bytes proxy for step points
     num_inputs = ((last_epoch + 1) * steps_per_epoch
                   + resume_batch) * global_batch
     # python-side completed-step counter (kill-fault drill only; the real
@@ -536,10 +575,19 @@ def main():
         rebuild = step_fn is None
         if configs.train.dgc:
             rebuild |= compression.warmup_compress_ratio(epoch)
+        # an epoch-boundary replan whose key() changed forces the one
+        # rebuild it already paid for; same-key refits never land here
+        rebuild |= autotune_pending
         if rebuild:
             # ratio change => new static attrs => new engine + re-jit
             # (reference compression.py:91-107; <= warmup_epochs+1 compiles)
             flat_setup = make_flat_setup(variables, dist)
+            if autotuner is not None:
+                # replan against the FRESH bucket geometry under the
+                # current (possibly refit) fabric — host-side only
+                flat_setup = make_flat_setup(
+                    variables, dist,
+                    plan=autotuner.plan_for(flat_setup.engine))
             step_fn = build_train_step(model.apply, dist, mesh,
                                        num_batches_per_step=nbps,
                                        use_dropout=use_dropout,
@@ -554,6 +602,12 @@ def main():
                 sink.write_record(dict(
                     flat_setup.engine.telemetry_static(),
                     event="engine_rebuild", epoch=epoch))
+            autotune_pending = False
+            # the (bytes, ms) proxy for this engine's steps: the sparse
+            # wire when the plan keeps one, else the dense psum bytes
+            if autotuner is not None:
+                at_wire = (flat_setup.engine.wire_bytes_per_worker()
+                           or 4 * flat_setup.layout.total)
 
         ds = dataset["train"]
         t0 = time.time()
@@ -638,6 +692,16 @@ def main():
                     if profile_left == 0:
                         jax.block_until_ready(metrics["loss"])
                         jax.profiler.stop_trace()
+                if autotuner is not None:
+                    # dispatch-interval (bytes, ms) point — host stamps
+                    # only, same proxy as the fleet w_clock lane; the
+                    # refit's prior-pinned intercept tolerates the
+                    # included compute time
+                    at_now = time.perf_counter()
+                    if at_prev is not None:
+                        autotuner.record_step((at_now - at_prev) * 1000.0,
+                                              at_wire)
+                    at_prev = at_now
                 seen += 1
                 num_inputs += global_batch
                 gstep += 1
@@ -700,6 +764,34 @@ def main():
             if streak is not None and streak.tripped:
                 aborted = True
                 break
+
+        if autotuner is not None:
+            # epoch boundary: refit the link model over the accumulated
+            # points (+ per-bucket device costs when a profile exists),
+            # persist <save_path>/fabric.json, replan. All host-side —
+            # zero extra collectives; a rebuild happens next epoch ONLY
+            # when the plan key changed.
+            at_prev = None       # don't span the eval/ckpt gap
+            profile = None
+            ppath = os.path.join(configs.train.save_path, "profile.json")
+            if os.path.exists(ppath):
+                try:
+                    from dgc_tpu.telemetry.attrib import load_profile
+                    profile = load_profile(ppath)
+                except (ValueError, OSError, KeyError):
+                    profile = None
+            new_plan = autotuner.epoch_end(flat_setup.engine, epoch=epoch,
+                                           profile=profile)
+            if new_plan is not None:
+                autotune_pending = True
+                printr(f"[autotune] refit {autotuner.fabric.gbps:.3g} GB/s"
+                       f" alpha {autotuner.fabric.alpha_ms:.3g} ms -> "
+                       f"replan {list(new_plan.regimes)} (rebuild next "
+                       f"epoch)")
+            elif autotuner.refit_count:
+                printr(f"[autotune] refit {autotuner.fabric.gbps:.3g} GB/s"
+                       f" alpha {autotuner.fabric.alpha_ms:.3g} ms — plan "
+                       f"unchanged (no recompile)")
 
         with tracer.span("eval", epoch=epoch):
             meters = evaluate(state)
