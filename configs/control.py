@@ -1,0 +1,44 @@
+"""Control-plane run profile (docs/TELEMETRY.md §"Control plane"): the
+full evidence chain a supervised fleet run should emit, in one module:
+
+    python -m dgc_tpu.control fleet.json     # runs usually stack this
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/control.py --elastic
+
+Stacks telemetry + fleet taps + the resilience layer so every detector in
+the rule table (dgc_tpu/control/rules.py) has its signal:
+
+* fleet per-worker columns -> straggler + desync detectors,
+* guard counters + flight recorder + nonfinite-streak abort (exit 70)
+  -> the quarantine detector,
+* emergency checkpoint on SIGTERM (exit 75) -> the restart / elastic
+  relaunch remediations can cycle the run without losing state.
+
+The control plane itself stays host-only: importing dgc_tpu.control does
+not change the compiled step program (the ``control-plane-host-only``
+contract in ``python -m dgc_tpu.analysis --gate``).
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+# telemetry + per-worker fleet lanes (one packed all_gather per step)
+if "telemetry" not in configs.train:
+    configs.train.telemetry = Config()
+    configs.train.telemetry.enabled = True
+    configs.train.telemetry.every = 1
+    configs.train.telemetry.rotate_mb = 64
+configs.train.telemetry.fleet = True
+
+# resilience: guards, emergency save (exit 75), flight recorder +
+# nonfinite-streak abort (exit 70) — the exit codes the rule table reads
+if "resilience" not in configs.train:
+    configs.train.resilience = Config()
+    configs.train.resilience.enabled = True
+    configs.train.resilience.nonfinite_guard = True
+    configs.train.resilience.spike_window = 0
+    configs.train.resilience.spike_factor = 10.0
+    configs.train.resilience.checksum = False
+    configs.train.resilience.watchdog_secs = 300
+    configs.train.resilience.emergency_checkpoint = True
+    configs.train.resilience.flight_steps = 256
+    configs.train.resilience.nonfinite_streak = 3

@@ -353,6 +353,19 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         identical_to=plain)
     run(atoff.name, atoff.check)
 
+    # control plane (ISSUE 12): supervision, rule evaluation, and
+    # remediation are host-side Python over JSONL streams — importing
+    # dgc_tpu.control must leave the compiled step byte-identical to the
+    # plain build and lower none of the control modules into it
+    import dgc_tpu.control  # noqa: F401 — import must not leak
+    _, step_ctl, _, _ = build_fixture(mesh, donate=False, telemetry=False)
+    ctl = _step_contract(
+        "control-plane-host-only", state, step_ctl, inputs,
+        forbid_substrings=["control/supervisor", "control/plane",
+                           "control/rules", "control/actions"],
+        identical_to=plain)
+    run(ctl.name, ctl.check)
+
     # online replanning: an epoch-boundary refit whose plan key() is
     # unchanged must cost ZERO recompiles (the stable autotuned-<base>
     # fabric name keeps key() fixed unless the REGIMES move) and the

@@ -1,0 +1,47 @@
+"""Fleet control plane: multi-run supervision, cross-run aggregation
+hooks, and alert-driven remediation (docs/TELEMETRY.md §"Control plane").
+
+Host-only by construction — nothing in this package may be imported into
+the compiled step program (pinned by the ``control-plane-host-only``
+contract in :mod:`dgc_tpu.analysis.suite`). The pieces:
+
+* :mod:`dgc_tpu.control.supervisor` — the launch/backoff/progress-watch
+  loop behind ``scripts/supervise.py``, importable.
+* :mod:`dgc_tpu.control.plane` — ``ControlPlane`` owning N supervisors on
+  threads, a fleet-wide JSONL event stream, and the tick loop that feeds
+  monitor snapshots to the rule engine.
+* :mod:`dgc_tpu.control.rules` — declarative detector → remediation table
+  with per-(run, rule) hit counting, debounce, and action budgets.
+* :mod:`dgc_tpu.control.actions` — the remediations themselves (restart,
+  elastic relaunch via the ``--env-file`` cohort republish, quarantine).
+
+``python -m dgc_tpu.control fleet.json`` runs a fleet from a spec file.
+"""
+
+import os
+
+from dgc_tpu.control.plane import ControlPlane, RunSpec  # noqa: F401
+from dgc_tpu.control.rules import Rule, RuleEngine, default_rules  # noqa: F401
+from dgc_tpu.control.supervisor import (  # noqa: F401
+    COHORT_KEYS,
+    Supervisor,
+    checkpoint_progress,
+    default_events_path,
+    parse_env_file,
+)
+
+__all__ = ["COHORT_KEYS", "ControlPlane", "Rule", "RuleEngine", "RunSpec",
+           "Supervisor", "checkpoint_progress", "default_events_path",
+           "default_rules", "parse_env_file", "resolve_run_id"]
+
+
+def resolve_run_id(default=None):
+    """The supervisor-assigned run id for this process, if any.
+
+    A ``Supervisor`` exports its ``run_id`` to every child as
+    ``DGC_RUN_ID``; train.py stamps it into the telemetry header and
+    flight-recorder static so the monitor can label every gauge with the
+    same ``run`` the supervise event stream carries. Unsupervised runs
+    get ``default`` (the monitor then falls back to the run dir name).
+    """
+    return os.environ.get("DGC_RUN_ID") or default

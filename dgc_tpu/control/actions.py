@@ -1,0 +1,115 @@
+"""Remediations the rule engine can execute on a supervised run.
+
+Each action takes the run's :class:`~dgc_tpu.control.supervisor.Supervisor`
+plus the triggering evidence and returns a result dict that rides the
+``control_action`` audit event — every mutation the control plane makes
+to the world (a SIGTERM, a cohort-spec publish, a quarantine flag) is
+recorded next to the evidence that justified it.
+
+The elastic relaunch goes through the PR-5 path end to end: the new
+cohort spec is *published* into the supervisor's ``--env-file`` (the same
+mechanism a human cluster operator uses), the child is SIGTERMed into its
+emergency-save / exit-75 path, and the relaunch re-reads the env-file,
+re-forms the cohort at W', and restores with ``--elastic`` resharding.
+"""
+
+import os
+import tempfile
+from typing import Dict, Optional
+
+from dgc_tpu.control.supervisor import Supervisor, parse_env_file
+
+__all__ = ["publish_env", "default_cohort_planner", "act_restart",
+           "act_elastic_relaunch", "act_quarantine", "ACTIONS", "execute"]
+
+
+def publish_env(path: str, updates: Dict[str, str]) -> Dict[str, str]:
+    """Merge ``updates`` into the KEY=VALUE env-file at ``path`` and
+    rewrite it atomically (the supervisor re-reads it before every
+    launch; it must never see a torn file). Returns the merged spec."""
+    merged = parse_env_file(path)
+    merged.update({k: str(v) for k, v in updates.items()})
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".cohort.", suffix=".env")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write("# published by dgc_tpu.control\n")
+            for k in sorted(merged):
+                f.write(f"{k}={merged[k]}\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return merged
+
+
+def default_cohort_planner(snap: Dict, evidence: Dict) -> Dict[str, str]:
+    """Propose the cohort-spec update for an elastic relaunch.
+
+    * cohort shrink — the spec chases reality: W' = live host count.
+    * straggler — drop one process (the slowest host leaves; the PR-5
+      reshard redistributes its residual mass at restore).
+    * anything else, or an unshrinkable single-process run — no update;
+      the action degrades to a plain restart and says so in the audit.
+    """
+    static = snap.get("static") or {}
+    try:
+        procs = int(static.get("num_processes") or 1)
+    except (TypeError, ValueError):
+        procs = 1
+    kind = evidence.get("kind")
+    if kind == "cohort_shrink":
+        return {"JAX_NUM_PROCESSES": str(int(evidence["live_hosts"]))}
+    if kind == "straggler" and procs > 1:
+        return {"JAX_NUM_PROCESSES": str(procs - 1)}
+    return {}
+
+
+def act_restart(sup: Supervisor, evidence: Dict, **_kw) -> Dict:
+    """SIGTERM → emergency save → exit 75 → relaunch, same cohort."""
+    delivered = sup.request_restart(reason=evidence.get("kind"))
+    return {"delivered": delivered}
+
+
+def act_elastic_relaunch(sup: Supervisor, evidence: Dict,
+                         env_updates: Optional[Dict[str, str]] = None,
+                         **_kw) -> Dict:
+    """Publish a new cohort spec through the env-file, then restart so
+    the relaunch restores elastically under it."""
+    result: Dict = {}
+    updates = dict(env_updates or {})
+    if updates and sup.env_file:
+        merged = publish_env(sup.env_file, updates)
+        result.update(env_file=sup.env_file, published=updates,
+                      cohort_spec={k: merged[k] for k in sorted(merged)})
+    else:
+        # no spec to publish (single process, or no env-file wired):
+        # still restart, but the audit must not claim a reshape happened
+        result.update(published={}, degraded_to="restart")
+    result["delivered"] = sup.request_restart(reason=evidence.get("kind"))
+    return result
+
+
+def act_quarantine(sup: Supervisor, evidence: Dict, **_kw) -> Dict:
+    """Stop relaunching; keep telemetry/flight/checkpoint artifacts."""
+    already = sup.quarantined is not None
+    sup.quarantine(evidence.get("kind", "quarantine"))
+    return {"quarantined": sup.quarantined, "already": already}
+
+
+#: action name (registry.CONTROL_ACTIONS) -> implementation
+ACTIONS = {
+    "restart": act_restart,
+    "elastic_relaunch": act_elastic_relaunch,
+    "quarantine": act_quarantine,
+}
+
+
+def execute(action: str, sup: Supervisor, evidence: Dict, **kw) -> Dict:
+    """Dispatch one remediation; unknown names raise (the registry and
+    this table must agree — checked in tests)."""
+    return ACTIONS[action](sup, evidence, **kw)

@@ -1,0 +1,216 @@
+"""``ControlPlane`` — N supervised runs, one tick loop, audited actions.
+
+One :class:`~dgc_tpu.control.supervisor.Supervisor` per run, each on its
+own thread (the child is a subprocess group of its own; the supervisor
+thread just launches, waits, and backs off). Every supervisor event is
+re-stamped with the run's fleet name and merged into one fleet-wide JSONL
+stream (``<fleet_root>/control_events.jsonl``) next to the plane's own
+events — ``plane_start``, per-rule ``control_action`` records (schema
+checked by :func:`dgc_tpu.telemetry.registry.validate_control_action`),
+``plane_stop``.
+
+The tick loop closes the observe → decide → act cycle:
+
+1. **observe** — :func:`dgc_tpu.telemetry.monitor.collect` on each run
+   dir (tolerant: a young or torn run yields no evidence, not an error),
+2. **decide** — :class:`dgc_tpu.control.rules.RuleEngine` applies the
+   declarative rule table with persistence/debounce/budget hygiene,
+3. **act** — :mod:`dgc_tpu.control.actions` executes the remediation
+   through the run's supervisor and the result is appended to the audit
+   stream with the triggering evidence attached.
+
+Quarantined runs are excluded from further rule evaluation: the plane
+stops reasoning about a run it has deliberately stopped healing.
+"""
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from dgc_tpu.control import actions as _actions
+from dgc_tpu.control.rules import Rule, RuleEngine
+from dgc_tpu.control.supervisor import Supervisor
+from dgc_tpu.telemetry import registry
+from dgc_tpu.telemetry.sink import JsonlAppender
+
+__all__ = ["RunSpec", "ControlPlane", "CONTROL_EVENTS"]
+
+#: fleet-wide event stream file name under the fleet root
+CONTROL_EVENTS = "control_events.jsonl"
+
+
+class RunSpec(NamedTuple):
+    """One run the plane supervises. ``name`` doubles as the fleet label
+    on every merged event and metric; ``run_dir`` is where the run's
+    telemetry / flight / supervise artifacts land (the monitor's view)."""
+    name: str
+    cmd: Sequence[str]
+    run_dir: str
+    watch: Optional[str] = None       # default: <run_dir>/checkpoints
+    env_file: Optional[str] = None    # cohort-spec publish target
+    env: Optional[Dict[str, str]] = None
+    retries: int = 5
+    backoff: float = 5.0
+    backoff_max: float = 300.0
+    success_codes: Tuple[int, ...] = (0,)
+
+
+class ControlPlane:
+    """Supervise a fleet of runs and remediate per the rule table."""
+
+    def __init__(self, specs: Sequence[RunSpec], fleet_root: str,
+                 rules: Optional[Sequence[Rule]] = None,
+                 interval: float = 5.0, events_out: Optional[str] = None,
+                 cohort_planner: Optional[Callable] = None,
+                 collect: Optional[Callable] = None):
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate run names in fleet: {names}")
+        self.fleet_root = os.path.abspath(fleet_root)
+        os.makedirs(self.fleet_root, exist_ok=True)
+        self.interval = float(interval)
+        self.stream = JsonlAppender(
+            events_out or os.path.join(self.fleet_root, CONTROL_EVENTS))
+        self.engine = RuleEngine(rules)
+        self._planner = cohort_planner or _actions.default_cohort_planner
+        if collect is None:
+            from dgc_tpu.telemetry import monitor as _monitor
+            collect = _monitor.collect
+        self._collect = collect
+        self.specs: Dict[str, RunSpec] = {}
+        self.supervisors: Dict[str, Supervisor] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._rcs: Dict[str, Optional[int]] = {}
+        self.actions: List[Dict] = []   # the in-memory audit trail
+        self._quarantine_audited: set = set()
+        self.ticks = 0
+        self._started = False
+        self._sleep = threading.Event()
+        for spec in specs:
+            os.makedirs(spec.run_dir, exist_ok=True)
+            sup = Supervisor(
+                spec.cmd,
+                retries=spec.retries, backoff=spec.backoff,
+                backoff_max=spec.backoff_max, env_file=spec.env_file,
+                watch=spec.watch or os.path.join(spec.run_dir, "checkpoints"),
+                events=os.path.join(spec.run_dir, "supervise_events.jsonl"),
+                success_codes=spec.success_codes, name=spec.name,
+                extra_env=spec.env,
+                on_event=lambda rec, _n=spec.name: self._merge(_n, rec))
+            self.specs[spec.name] = spec
+            self.supervisors[spec.name] = sup
+            self._rcs[spec.name] = None
+
+    # ------------------------------------------------------------------ #
+    # event stream                                                       #
+    # ------------------------------------------------------------------ #
+
+    def _merge(self, name: str, rec: Dict) -> None:
+        """Supervisor event -> fleet stream, stamped with the run name."""
+        self.stream.write(dict(rec, run=name))
+
+    def _plane_event(self, kind: str, **fields) -> None:
+        self.stream.write(dict(fields, event=kind, t=time.time()))
+
+    # ------------------------------------------------------------------ #
+    # lifecycle                                                          #
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._plane_event(
+            "plane_start", fleet_root=self.fleet_root,
+            runs={n: {"cmd": list(s.cmd), "run_dir": s.run_dir}
+                  for n, s in self.specs.items()},
+            rules=[r.name for r in self.engine.rules])
+        for name, sup in self.supervisors.items():
+            t = threading.Thread(
+                target=self._supervise, args=(name, sup),
+                name=f"dgc-control-{name}", daemon=True)
+            self._threads[name] = t
+            t.start()
+
+    def _supervise(self, name: str, sup: Supervisor) -> None:
+        # plane threads must not touch signal handlers (main-thread-only)
+        self._rcs[name] = sup.run(install_signals=False)
+
+    def alive(self) -> bool:
+        return any(t.is_alive() for t in self._threads.values())
+
+    def poll(self) -> Dict[str, Dict]:
+        """Per-run view: supervisor state, launches, last rc."""
+        return {
+            name: {"state": sup.state, "launches": sup.launches,
+                   "last_rc": sup.last_rc, "rc": self._rcs[name],
+                   "run_id": sup.run_id, "quarantined": sup.quarantined}
+            for name, sup in self.supervisors.items()
+        }
+
+    def stop(self) -> None:
+        """Stop every run (SIGTERM through the supervisors) and wake the
+        tick loop; the supervisors stop relaunching."""
+        for sup in self.supervisors.values():
+            sup.request_stop()
+        self._sleep.set()
+
+    # ------------------------------------------------------------------ #
+    # observe -> decide -> act                                           #
+    # ------------------------------------------------------------------ #
+
+    def tick(self, now: Optional[float] = None) -> List[Dict]:
+        """One control cycle over every live run; returns the
+        ``control_action`` records fired this tick."""
+        now = time.monotonic() if now is None else now
+        self.ticks += 1
+        fired: List[Dict] = []
+        for name, sup in self.supervisors.items():
+            if sup.quarantined is not None:
+                # a self-quarantine (exit 70) still gets ONE audited pass
+                # so the evidence lands in the action trail; after that
+                # the plane stops reasoning about the run
+                if name in self._quarantine_audited:
+                    continue
+            try:
+                snap = self._collect(self.specs[name].run_dir)
+            except Exception:
+                continue    # young/torn/missing run: no evidence yet
+            for rule, evidence in self.engine.evaluate(name, snap, now):
+                kw = {}
+                if rule.action == "elastic_relaunch":
+                    kw["env_updates"] = self._planner(snap, evidence)
+                result = _actions.execute(rule.action, sup, evidence, **kw)
+                rec = {"event": "control_action", "run": name,
+                       "run_id": sup.run_id, "rule": rule.name,
+                       "action": rule.action, "evidence": evidence,
+                       "result": result, "t": time.time()}
+                registry.validate_control_action(rec)
+                self.stream.write(rec)
+                self.actions.append(rec)
+                fired.append(rec)
+                if rule.action == "quarantine":
+                    self._quarantine_audited.add(name)
+                    break   # no further reasoning about this run
+        return fired
+
+    def run(self, max_ticks: Optional[int] = None) -> Dict[str, Dict]:
+        """Start the fleet and tick until every run ends (or ``max_ticks``
+        control cycles pass — then the fleet is stopped). Returns the
+        final :meth:`poll` view."""
+        self.start()
+        while self.alive():
+            if max_ticks is not None and self.ticks >= max_ticks:
+                self.stop()
+                break
+            self._sleep.wait(self.interval)
+            self._sleep.clear()
+            self.tick()
+        for t in self._threads.values():
+            t.join(timeout=max(30.0, 2 * self.interval))
+        self.tick()     # final pass: audit anything the exits revealed
+        final = self.poll()
+        self._plane_event("plane_stop", ticks=self.ticks,
+                          actions=len(self.actions), runs=final)
+        return final

@@ -1,0 +1,177 @@
+"""Declarative alert → remediation rules for the control plane.
+
+A :class:`Rule` binds a *detector* — a pure function over one run's
+monitor snapshot (:func:`dgc_tpu.telemetry.monitor.collect`) returning
+evidence or ``None`` — to a named remediation from
+:data:`dgc_tpu.telemetry.registry.CONTROL_ACTIONS`. The
+:class:`RuleEngine` adds the operational hygiene every auto-remediation
+needs:
+
+* **persistence** (``min_hits``) — the detector must fire on that many
+  *consecutive* ticks before the rule does; one noisy snapshot never
+  restarts a run.
+* **debounce** (``debounce_s``) — after firing, the rule stays quiet for
+  a window so the remediation has time to take effect before the same
+  evidence (which may persist through a restart) can fire it again.
+* **budget** (``budget``) — a hard per-(run, rule) cap on firings for
+  the plane's lifetime; a remediation that doesn't stick escalates to a
+  human instead of flapping forever.
+
+Suppressed firings (debounced or over budget) are counted and visible
+via ``engine.suppressed`` — silence must be attributable too. The engine
+takes ``now`` explicitly so tests drive it with a fake clock.
+"""
+
+import math
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
+
+__all__ = ["Rule", "RuleEngine", "default_rules", "detect_desync",
+           "detect_straggler", "detect_quarantine", "detect_cohort_shrink"]
+
+
+class Rule(NamedTuple):
+    """One row of the remediation table."""
+    name: str
+    detect: Callable[[Dict], Optional[Dict]]
+    action: str                 # a registry.CONTROL_ACTIONS name
+    min_hits: int = 2           # consecutive detecting ticks before firing
+    debounce_s: float = 60.0    # quiet window after a firing
+    budget: int = 2             # lifetime firings per (run, rule)
+
+
+# ---------------------------------------------------------------------- #
+# detectors — tolerant by design: a half-collected snapshot (young run,  #
+# torn shard, no supervise stream yet) must read as "no evidence", never #
+# raise                                                                  #
+# ---------------------------------------------------------------------- #
+
+def detect_desync(snap: Dict) -> Optional[Dict]:
+    """A worker's residual walked out of the cohort's rolling band
+    (:func:`dgc_tpu.telemetry.fleet.detect_desync` verdict in the
+    snapshot summary) — the silent-corruption signature. Remediation:
+    restart the run so it restores from the last good checkpoint."""
+    s = snap.get("summary") or {}
+    alerts = s.get("desync_alerts") or 0
+    workers = s.get("desync_workers") or []
+    if alerts and workers:
+        return {"kind": "desync", "alerts": int(alerts),
+                "workers": list(workers), "first": s.get("desync_first")}
+    return None
+
+
+def detect_straggler(snap: Dict, min_share: float = 1.5,
+                     min_gap_ms: float = 20.0) -> Optional[Dict]:
+    """One worker persistently slower than the cohort mean by
+    ``min_share`` (and trailing by at least ``min_gap_ms``) — the whole
+    cohort runs at its pace. Remediation: publish a smaller cohort spec
+    and elastically relaunch without it."""
+    s = snap.get("summary") or {}
+    share = s.get("straggler_share")
+    gap = s.get("straggler_gap")
+    worker = s.get("straggler")
+    if (share is not None and gap is not None and worker is not None
+            and math.isfinite(share) and share >= min_share
+            and gap >= min_gap_ms):
+        return {"kind": "straggler", "worker": int(worker),
+                "share": float(share), "gap_ms": float(gap)}
+    return None
+
+
+def detect_quarantine(snap: Dict, max_nonfinite_rate: float = 0.5) \
+        -> Optional[Dict]:
+    """The run is numerically dead or crashed hard: a flight-recorder
+    dump on disk, a nonfinite-streak abort (exit 70), or a saturated
+    nonfinite guard rate. Remediation: quarantine — relaunching a run
+    that diverges deterministically just burns the retry budget and
+    overwrites the evidence."""
+    flight = snap.get("flight") or {}
+    if flight.get("reason"):
+        return {"kind": "flight_dump", "reason": flight["reason"],
+                "t_dump": flight.get("t_dump"),
+                "records": flight.get("records")}
+    last = snap.get("last_supervise") or {}
+    if last.get("event") in ("relaunch", "quarantined", "giveup") \
+            and last.get("rc") == 70:
+        return {"kind": "nonfinite_abort", "rc": 70,
+                "supervise_event": last.get("event")}
+    guards = snap.get("guards") or {}
+    rate = guards.get("nonfinite_rate")
+    if rate is not None and rate > max_nonfinite_rate:
+        return {"kind": "nonfinite_rate", "nonfinite_rate": float(rate),
+                "skipped_steps": guards.get("skipped_steps")}
+    return None
+
+
+def detect_cohort_shrink(snap: Dict) -> Optional[Dict]:
+    """Fewer hosts writing telemetry than the run's recorded cohort spec
+    — a process died without its supervisor noticing (the others block in
+    collectives at the next exchange). Remediation: publish the shrunken
+    cohort through the env-file and elastically relaunch at W' = live."""
+    static = snap.get("static") or {}
+    want = static.get("num_processes")
+    have = snap.get("num_hosts")
+    try:
+        want = int(want) if want is not None else None
+    except (TypeError, ValueError):
+        want = None
+    if want and have and int(have) < want:
+        return {"kind": "cohort_shrink", "live_hosts": int(have),
+                "spec_processes": want}
+    return None
+
+
+def default_rules() -> Tuple[Rule, ...]:
+    """The shipped remediation table (docs/TELEMETRY.md §"Control plane").
+    Order matters: quarantine outranks everything — a numerically dead
+    run must never be "fixed" by a restart rule on the same tick."""
+    return (
+        Rule("nonfinite-quarantine", detect_quarantine, "quarantine",
+             min_hits=1, debounce_s=0.0, budget=1),
+        Rule("desync-restart", detect_desync, "restart",
+             min_hits=2, debounce_s=60.0, budget=2),
+        Rule("straggler-relaunch", detect_straggler, "elastic_relaunch",
+             min_hits=3, debounce_s=120.0, budget=1),
+        Rule("cohort-shrink-relaunch", detect_cohort_shrink,
+             "elastic_relaunch", min_hits=2, debounce_s=120.0, budget=2),
+    )
+
+
+class RuleEngine:
+    """Stateful evaluator: consecutive-hit counting, debounce, budget."""
+
+    def __init__(self, rules: Optional[Tuple[Rule, ...]] = None):
+        self.rules = tuple(default_rules() if rules is None else rules)
+        self._hits: Dict[Tuple[str, str], int] = {}
+        self._fired_t: Dict[Tuple[str, str], float] = {}
+        self._fired_n: Dict[Tuple[str, str], int] = {}
+        #: (run, rule) -> count of firings suppressed by debounce/budget
+        self.suppressed: Dict[Tuple[str, str], int] = {}
+
+    def evaluate(self, run: str, snap: Dict, now: float):
+        """One tick for one run: returns ``[(rule, evidence), ...]`` for
+        every rule that fires now. Evidence is the detector's dict plus
+        ``hits`` (consecutive detecting ticks) and ``firing`` (1-based
+        count against the budget)."""
+        fired = []
+        for rule in self.rules:
+            key = (run, rule.name)
+            try:
+                evidence = rule.detect(snap)
+            except Exception:
+                evidence = None     # a detector crash is not evidence
+            if not evidence:
+                self._hits[key] = 0
+                continue
+            self._hits[key] = self._hits.get(key, 0) + 1
+            if self._hits[key] < rule.min_hits:
+                continue
+            last = self._fired_t.get(key)
+            if ((last is not None and now - last < rule.debounce_s)
+                    or self._fired_n.get(key, 0) >= rule.budget):
+                self.suppressed[key] = self.suppressed.get(key, 0) + 1
+                continue
+            self._fired_t[key] = now
+            self._fired_n[key] = self._fired_n.get(key, 0) + 1
+            fired.append((rule, dict(evidence, hits=self._hits[key],
+                                     firing=self._fired_n[key])))
+        return fired

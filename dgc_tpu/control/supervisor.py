@@ -1,0 +1,344 @@
+"""Restart supervisor as a library (docs/RESILIENCE.md §"Elastic restart",
+docs/TELEMETRY.md §"Control plane").
+
+The launch / exponential-backoff / progress-watch loop that used to live
+inside ``scripts/supervise.py`` — extracted so the control plane
+(:mod:`dgc_tpu.control.plane`) can own N of them concurrently, one thread
+each. ``scripts/supervise.py`` remains the thin single-run CLI over this
+class with its flag surface and event schema unchanged.
+
+Mechanics (shared by CLI and control plane):
+
+* ``env_file`` is re-read before EVERY launch and its ``KEY=VALUE`` lines
+  override the child environment — the cluster manager's (and the control
+  plane's) hook for publishing a new cohort spec
+  (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+  ``JAX_PROCESS_ID``) after a slice comes back with a different shape.
+* a child exit code in ``success_codes`` (default ``0``) ends the loop
+  successfully; a code in ``quarantine_codes`` (default ``70``,
+  EX_SOFTWARE — the nonfinite-streak abort in train.py) quarantines the
+  run: no relaunch, artifacts kept for post-mortem. Exit code 75
+  (EX_TEMPFAIL) is the convention for "preempted after a clean emergency
+  save — relaunch me"; anything else relaunches against the retry budget.
+* retries are budgeted against *progress*: when ``watch`` names the
+  checkpoint directory and its ``latest.json`` changed since the last
+  launch (an emergency save counts), the failure counter resets.
+* every event is stamped with a per-supervisor ``run_id`` and the cohort
+  spec from the latest env read, flushed per event; the same ``run_id``
+  is exported to the child as ``DGC_RUN_ID`` so its telemetry header and
+  the supervise stream agree on which run this is.
+
+Library extensions on top of the CLI behavior — all host-only, called
+from the control plane's thread:
+
+* ``on_event`` — callback receiving every event record (the plane's
+  fleet-wide stream re-stamps and merges them).
+* ``request_restart()`` — SIGTERM the child *without* stopping the loop:
+  the child takes its emergency-save path, exits 75, and the loop
+  relaunches it (with whatever cohort spec the env-file now publishes).
+* ``request_stop()`` — SIGTERM the child and stop relaunching (the CLI's
+  signal handler routes here).
+* ``quarantine(reason)`` — stop relaunching but keep artifacts; also
+  entered automatically on a ``quarantine_codes`` exit.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from dgc_tpu.telemetry.sink import JsonlAppender
+
+__all__ = ["parse_env_file", "checkpoint_progress", "COHORT_KEYS",
+           "default_events_path", "Supervisor", "main"]
+
+
+def parse_env_file(path):
+    """KEY=VALUE lines (blank lines and ``#`` comments ignored)."""
+    out = {}
+    if not path or not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def checkpoint_progress(watch_dir):
+    """(epoch, mtime) of ``latest.json``; None when absent/unreadable."""
+    if not watch_dir:
+        return None
+    path = os.path.join(watch_dir, "latest.json")
+    try:
+        with open(path) as f:
+            epoch = json.load(f).get("epoch")
+        return (epoch, os.path.getmtime(path))
+    except (OSError, ValueError):
+        return None
+
+
+#: cohort-spec env keys stamped into every event (the monitor's view of
+#: the world shape each launch ran under)
+COHORT_KEYS = ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+               "JAX_COORDINATOR_ADDRESS")
+
+
+def default_events_path(watch):
+    """``supervise_events.jsonl`` next to the watched checkpoint dir —
+    i.e. under the run dir, where the live monitor looks for it."""
+    if not watch:
+        return None
+    return os.path.join(os.path.dirname(os.path.abspath(watch)),
+                        "supervise_events.jsonl")
+
+
+class Supervisor:
+    """Bounded-retry relaunch loop for one training run.
+
+    ``run()`` blocks until the run ends (done / stopped / gave up /
+    quarantined) and returns the final child exit code (0 on success) —
+    run it on a dedicated thread when supervising a fleet. All the
+    ``request_*`` methods are safe to call from another thread.
+    """
+
+    def __init__(self, cmd, retries=5, backoff=5.0, backoff_max=300.0,
+                 env_file=None, watch=None, events=None,
+                 success_codes=(0,), quarantine_codes=(70,),
+                 name=None, extra_env=None, on_event=None):
+        self.cmd = list(cmd)
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self.backoff_max = float(backoff_max)
+        self.env_file = env_file
+        self.watch = watch
+        self.events_path = events
+        self.success_codes = set(success_codes)
+        self.quarantine_codes = set(quarantine_codes or ())
+        self.name = name
+        self.extra_env = dict(extra_env or {})
+        self.on_event = on_event
+        self.child = None
+        self.shutting_down = False
+        self.quarantined = None     # reason string once quarantined
+        self.launches = 0
+        self.last_rc = None
+        self.state = "idle"         # running|done|stopped|gave_up|quarantined
+        # one id per supervisor lifetime: every relaunch of this run
+        # shares it, a fresh supervisor gets a fresh one
+        stamp = time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}"
+        self.run_id = f"{name}-{stamp}" if name else stamp
+        self.cohort = {k: os.environ.get(k) for k in COHORT_KEYS
+                       if os.environ.get(k) is not None}
+        self._events = JsonlAppender(events) if events else None
+        self._wake = threading.Event()
+
+    # ------------------------------------------------------------------ #
+    # events                                                             #
+    # ------------------------------------------------------------------ #
+
+    def event(self, kind, **fields):
+        rec = dict(fields, event=kind, t=time.time(),
+                   launches=self.launches, run_id=self.run_id,
+                   cohort=self.cohort)
+        tag = f"[supervise:{self.name}]" if self.name else "[supervise]"
+        line = json.dumps(rec)
+        print(f"{tag} {line}", flush=True)
+        if self._events is not None:
+            # persistent handle, flushed per event: a tailing monitor
+            # sees every launch/relaunch as it happens, and relaunch
+            # churn doesn't reopen the file hundreds of times
+            self._events.write(rec)
+        if self.on_event is not None:
+            try:
+                self.on_event(dict(rec))
+            except Exception as e:  # a broken stream must not kill the run
+                print(f"{tag} on_event failed: {e!r}", flush=True)
+
+    # ------------------------------------------------------------------ #
+    # cross-thread controls                                              #
+    # ------------------------------------------------------------------ #
+
+    def _signal_child(self, signum=signal.SIGTERM):
+        child = self.child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signum)
+                return True
+            except OSError:
+                pass
+        return False
+
+    def request_restart(self, reason=None):
+        """SIGTERM the child WITHOUT stopping the loop: it emergency-saves,
+        exits 75, and relaunches under the current env-file cohort spec.
+        Returns True when the signal was delivered to a live child."""
+        delivered = self._signal_child(signal.SIGTERM)
+        self.event("restart_request", reason=reason, delivered=delivered)
+        return delivered
+
+    def request_stop(self, reason="signal"):
+        """Stop relaunching and pass SIGTERM through so the child takes
+        its emergency-save path (the CLI signal handler routes here)."""
+        self.shutting_down = True
+        self._signal_child(signal.SIGTERM)
+        self._wake.set()
+
+    def quarantine(self, reason):
+        """Stop relaunching but keep every artifact (telemetry, flight
+        dump, checkpoints) for post-mortem. Does NOT kill a live child —
+        a run is quarantined for what it did, not executed for it."""
+        if self.quarantined is None:
+            self.quarantined = str(reason)
+        self._wake.set()
+
+    def _forward(self, signum, frame):
+        # the scheduler is tearing US down: stop relaunching, pass the
+        # signal through so the child takes its emergency-save path
+        self.shutting_down = True
+        self._signal_child(signum)
+        self._wake.set()
+
+    # ------------------------------------------------------------------ #
+    # the loop                                                           #
+    # ------------------------------------------------------------------ #
+
+    def run(self, install_signals=None):
+        """Supervise until the run ends; returns the final exit code.
+        ``install_signals`` defaults to True only on the main thread
+        (signal.signal is main-thread-only; plane threads skip it)."""
+        if install_signals is None:
+            install_signals = (threading.current_thread()
+                               is threading.main_thread())
+        if install_signals:
+            for s in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(s, self._forward)
+        self.state = "running"
+        failures = 0
+        while True:
+            env = dict(os.environ)
+            env.update(self.extra_env)      # the run's baseline env ...
+            overrides = parse_env_file(self.env_file)
+            env.update(overrides)           # ... under the LIVE cohort spec
+            # the child's telemetry header and this event stream must
+            # agree on which run this is
+            env["DGC_RUN_ID"] = self.run_id
+            # latest cohort spec (the env-file may have re-shaped the
+            # world since the last launch) rides every event from here on
+            self.cohort = {k: env.get(k) for k in COHORT_KEYS
+                           if env.get(k) is not None}
+            before = checkpoint_progress(self.watch)
+            self.launches += 1
+            self.event("launch", cmd=self.cmd,
+                       world=env.get("JAX_NUM_PROCESSES"),
+                       env_overrides=sorted(overrides))
+            t0 = time.time()
+            self.child = subprocess.Popen(self.cmd, env=env)
+            rc = self.child.wait()
+            self.child = None
+            self.last_rc = rc
+            elapsed = time.time() - t0
+            if rc in self.success_codes:
+                self.state = "done"
+                self.event("done", rc=rc, elapsed=elapsed)
+                return 0
+            after = checkpoint_progress(self.watch)
+            progressed = after is not None and after != before
+            if progressed:
+                # visible checkpoint progress (a preemption's emergency
+                # save included) is not a failure: the retry budget
+                # guards against crash loops, not against preemptions
+                failures = 0
+            else:
+                failures += 1
+            if rc in self.quarantine_codes and self.quarantined is None:
+                self.quarantined = f"exit:{rc}"
+            if self.quarantined is not None:
+                self.state = "quarantined"
+                self.event("quarantined", rc=rc, reason=self.quarantined)
+                return rc
+            if self.shutting_down:
+                self.state = "stopped"
+                self.event("stopped", rc=rc, reason="signal")
+                return rc
+            if failures > self.retries:
+                self.state = "gave_up"
+                self.event("giveup", rc=rc, failures=failures,
+                           retries=self.retries)
+                return rc
+            delay = min(self.backoff * (2 ** max(failures - 1, 0)),
+                        self.backoff_max)
+            self.event("relaunch", rc=rc, elapsed=elapsed,
+                       failures=failures, delay=delay,
+                       progressed=progressed)
+            # interruptible backoff: a stop/quarantine lands immediately
+            # instead of after the full delay
+            self._wake.wait(delay)
+            self._wake.clear()
+            if self.quarantined is not None:
+                self.state = "quarantined"
+                self.event("quarantined", rc=rc, reason=self.quarantined)
+                return rc
+            if self.shutting_down:
+                self.state = "stopped"
+                self.event("stopped", rc=rc, reason="signal")
+                return rc
+
+
+def main(argv=None):
+    """The ``scripts/supervise.py`` CLI: one run, this process's signals."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="Restart supervisor for elastic training "
+                    "(docs/RESILIENCE.md §\"Elastic restart\").",
+        usage="supervise.py [options] -- <training command ...>")
+    parser.add_argument("--retries", type=int, default=5,
+                        help="consecutive no-progress failures before "
+                             "giving up (progress resets the count)")
+    parser.add_argument("--backoff", type=float, default=5.0,
+                        help="initial relaunch delay, doubled per "
+                             "consecutive failure")
+    parser.add_argument("--backoff-max", type=float, default=300.0)
+    parser.add_argument("--env-file", default=None,
+                        help="KEY=VALUE file re-read before every launch; "
+                             "overrides the child environment (new cohort "
+                             "spec goes here)")
+    parser.add_argument("--watch", default=None,
+                        help="checkpoint directory; progress in its "
+                             "latest.json resets the retry budget")
+    parser.add_argument("--events-out", default=None,
+                        help="append one JSON line per supervisor event; "
+                             "defaults to supervise_events.jsonl next to "
+                             "the --watch dir (under the run dir)")
+    parser.add_argument("--events", default=None,
+                        help="legacy alias for --events-out (takes "
+                             "precedence when both are given)")
+    parser.add_argument("--success-codes", default="0",
+                        help="comma-separated child exit codes that end "
+                             "the loop successfully")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- then the training command")
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no training command given (put it after --)")
+    events = (args.events or args.events_out
+              or default_events_path(args.watch))
+    sup = Supervisor(
+        cmd, retries=args.retries, backoff=args.backoff,
+        backoff_max=args.backoff_max, env_file=args.env_file,
+        watch=args.watch, events=events,
+        success_codes={int(c) for c in args.success_codes.split(",")})
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,8 +54,8 @@ from dgc_tpu.telemetry import registry, sink as _sink
 
 __all__ = [
     "gather_stats", "make_clock", "FleetView", "DesyncAlert",
-    "discover_shards", "load_view", "worker_series", "detect_desync",
-    "straggler_table", "fleet_summary",
+    "discover_shards", "discover_runs", "load_view", "worker_series",
+    "detect_desync", "straggler_table", "fleet_summary",
 ]
 
 #: fleet lanes appended to the packed telemetry vector, in order
@@ -197,11 +197,18 @@ def _rotation_key(path: str):
     return (int(m.group(1)) if m else -1, path)
 
 
+#: JSONL files that live beside telemetry shards but are not sink files:
+#: supervisor / control-plane event streams and MetricWriter's training
+#: metric log (a run that only has the latter is not a telemetry run)
+_EVENT_STREAMS = ("supervise_events.jsonl", "control_events.jsonl",
+                  "metrics.jsonl")
+
+
 def _shard_files(root: str) -> List[str]:
-    # the supervisor's event stream lives beside the shards but is not a
-    # sink file — never merge it as one
+    # the supervisor's / control plane's event streams live beside the
+    # shards but are not sink files — never merge them as one
     return sorted((p for p in _glob.glob(os.path.join(root, "*.jsonl"))
-                   if os.path.basename(p) != "supervise_events.jsonl"),
+                   if os.path.basename(p) not in _EVENT_STREAMS),
                   key=_rotation_key)
 
 
@@ -233,6 +240,35 @@ def discover_shards(run: str) -> Dict[str, List[str]]:
         if files:
             return {"host0": files}
     return {}
+
+
+def discover_runs(fleet_root: str) -> Dict[str, str]:
+    """Map a fleet root to ``{run_name: run_path}`` for the cross-run
+    monitor (docs/TELEMETRY.md §"Control plane").
+
+    A *run* is any direct subdirectory with discoverable telemetry
+    shards, or one a supervisor has started writing an event stream for
+    (so a just-launched run appears in the fleet view before its first
+    telemetry record). When the root has no such subdirectories but is
+    itself a run dir, it maps to its own basename — pointing the fleet
+    monitor at a single run degrades gracefully."""
+    out: Dict[str, str] = {}
+    if not os.path.isdir(fleet_root):
+        return out
+    for name in sorted(os.listdir(fleet_root)):
+        path = os.path.join(fleet_root, name)
+        if not os.path.isdir(path) or name == "telemetry" \
+                or re.fullmatch(r"host\d+", name):
+            # a telemetry/ subdir or host<i>/ shard dirs mean the ROOT
+            # is itself a single run, not a fleet of them
+            continue
+        if discover_shards(path) or os.path.isfile(
+                os.path.join(path, "supervise_events.jsonl")):
+            out[name] = path
+    if not out and discover_shards(fleet_root):
+        base = os.path.basename(os.path.normpath(fleet_root)) or "run"
+        out[base] = fleet_root
+    return out
 
 
 def load_view(run: str) -> FleetView:

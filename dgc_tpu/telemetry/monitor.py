@@ -11,11 +11,26 @@ telemetry shards through the tolerant reader, merges the fleet view
   guard counters / per-worker straggler table / desync verdict / the last
   run event and the last ``scripts/supervise.py`` relaunch event.
 
+Every gauge carries a ``run="…"`` label (the supervisor-assigned
+``run_id`` when the run is supervised, else the run dir name) so
+single-run and fleet scrapes share one label schema; per-worker series
+add ``worker="i"`` alongside it.
+
+Fleet mode (``--fleet``) points the same monitor at a *fleet root* — a
+directory of run dirs as laid out by ``python -m dgc_tpu.control``:
+``discover_runs`` finds every run, ``/metrics`` serves ONE merged
+exposition with each sample distinguished by its ``run`` label, and the
+status view becomes a health-ranked table (worst first: collection
+errors, quarantines/flight dumps, desync verdicts, stragglers, guard
+trips, then step rate) with the control plane's recent remediation
+actions underneath.
+
 ::
 
     python -m dgc_tpu.telemetry.monitor runs/exp           # serve + tail
     python -m dgc_tpu.telemetry.monitor runs/exp --once    # render once
     python -m dgc_tpu.telemetry.monitor runs/exp --once --openmetrics
+    python -m dgc_tpu.telemetry.monitor runs/fleet --fleet # whole fleet
 
 The monitor is a pure reader: plain file tailing + numpy, no jax, no
 writes into the run directory, safe to run beside (or long after) the
@@ -35,11 +50,20 @@ import numpy as np
 
 from dgc_tpu.telemetry import fleet as _fleet
 
-__all__ = ["collect", "render_openmetrics", "render_status", "serve",
-           "supervise_events_path", "read_supervise_events"]
+__all__ = ["collect", "collect_fleet", "render_openmetrics",
+           "render_openmetrics_fleet", "render_status",
+           "render_fleet_status", "rank_runs", "serve",
+           "supervise_events_path", "read_supervise_events",
+           "read_control_events"]
 
 #: default event-stream filename scripts/supervise.py writes under the run
 SUPERVISE_EVENTS = "supervise_events.jsonl"
+
+#: default fleet-wide event stream the control plane writes under the root
+CONTROL_EVENTS = "control_events.jsonl"
+
+#: guard counters surfaced in the status view / quarantine evidence
+_GUARD_KEYS = ("skipped_steps", "nonfinite_rate", "checksum_failures")
 
 #: OpenMetrics names for the per-worker fleet columns
 _WORKER_GAUGES = {
@@ -115,11 +139,14 @@ def read_supervise_events(run: str) -> List[Dict]:
 
 def collect(run: str, *, rate_window: int = 50) -> Dict:
     """One monitor snapshot of a run: latest record, derived rates, fleet
-    summary, straggler table, and the trailing events. Pure read."""
+    summary, straggler table, guard counters, flight-recorder dump, and
+    the trailing events. Pure read."""
     view = _fleet.load_view(run)
     steps = view.steps
     last = steps[-1] if steps else {}
     static = view.header.get("static", {})
+    base = run if os.path.isdir(run) else os.path.dirname(
+        os.path.abspath(run))
     snap: Dict = {
         "run": run,
         "t_collect": time.time(),
@@ -153,11 +180,40 @@ def collect(run: str, *, rate_window: int = 50) -> Dict:
         snap["compression_ratio"] = round(float(total) / payload, 2)
     if view.events:
         snap["last_event"] = view.events[-1]
+    # guard counters from the newest record that carries them (the last
+    # record of a crashing run may be a bare event row)
+    for r in reversed(steps):
+        if any(isinstance(r.get(k), (int, float)) for k in _GUARD_KEYS):
+            snap["guards"] = {k: r[k] for k in _GUARD_KEYS
+                              if isinstance(r.get(k), (int, float))}
+            break
+    # flight-recorder dump next to the run — the quarantine evidence
+    fpath = os.path.join(base, "flight.json")
+    if os.path.isfile(fpath):
+        try:
+            from dgc_tpu.telemetry import flight as _flight
+            dump = _flight.load_dump(fpath)
+            snap["flight"] = {
+                "reason": dump.get("reason"),
+                "t_dump": dump.get("t_dump"),
+                "records": len(dump.get("records") or []),
+                "path": fpath,
+            }
+        except (OSError, ValueError):
+            snap["flight"] = {"reason": "unreadable", "path": fpath}
     sup = read_supervise_events(run)
     if sup:
         snap["supervise_launches"] = max(
             (int(e.get("launches", 0)) for e in sup), default=0)
         snap["last_supervise"] = sup[-1]
+    # the run label every gauge carries: supervisor-assigned run_id when
+    # supervised (the event stream and the child's DGC_RUN_ID agree),
+    # else the header's run_id, else the run dir name
+    run_id = next((e["run_id"] for e in reversed(sup)
+                   if e.get("run_id")), None) if sup else None
+    snap["run_label"] = str(
+        run_id or static.get("run_id")
+        or os.path.basename(os.path.normpath(base)) or "run")
     return snap
 
 
@@ -171,56 +227,125 @@ def _fmt(v: float) -> str:
     return repr(int(f)) if f.is_integer() and abs(f) < 2**53 else repr(f)
 
 
-def render_openmetrics(snap: Dict) -> str:
-    """OpenMetrics text exposition for one snapshot — gauges only, each
-    with HELP/TYPE, per-worker series labeled, ``# EOF`` terminated."""
-    lines: List[str] = []
+def _esc(v) -> str:
+    # OpenMetrics label-value escaping
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _labels(run: str, **extra) -> str:
+    parts = [f'run="{_esc(run)}"']
+    parts += [f'{k}="{_esc(v)}"' for k, v in extra.items()]
+    return "{" + ",".join(parts) + "}"
+
+
+def _snap_samples(snap: Dict, families: Dict) -> None:
+    """Append one snapshot's gauge samples into the ordered family map
+    ``{name: (help, [(labels, value), ...])}`` — shared by the single-run
+    and merged-fleet expositions so both carry the same label schema
+    (every sample labeled ``run="…"``, per-worker series additionally
+    ``worker="i"``)."""
+    run = snap.get("run_label", "run")
 
     def gauge(name, help_, samples):
-        lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} gauge")
-        for labels, value in samples:
-            lines.append(f"{name}{labels} {_fmt(value)}")
+        families.setdefault(name, (help_, []))[1].extend(samples)
 
     gauge("dgc_step", "latest recorded step (sample-count cursor)",
-          [("", snap.get("step", 0))])
+          [(_labels(run), snap.get("step", 0))])
     gauge("dgc_records", "step records merged across host shards",
-          [("", snap.get("num_steps", 0))])
-    gauge("dgc_world", "cohort world size", [("", snap.get("world", 0))])
+          [(_labels(run), snap.get("num_steps", 0))])
+    gauge("dgc_world", "cohort world size",
+          [(_labels(run), snap.get("world", 0))])
     gauge("dgc_hosts", "host shards merged",
-          [("", snap.get("num_hosts", 0))])
+          [(_labels(run), snap.get("num_hosts", 0))])
     gauge("dgc_skipped_lines",
           "torn JSONL lines skipped by the tolerant reader",
-          [("", snap.get("skipped_lines", 0))])
+          [(_labels(run), snap.get("skipped_lines", 0))])
     if "steps_per_s" in snap:
         gauge("dgc_steps_per_second",
               "record rate over the trailing window",
-              [("", snap["steps_per_s"])])
+              [(_labels(run), snap["steps_per_s"])])
     if "compression_ratio" in snap:
         gauge("dgc_compression_ratio",
               "model elements / transmitted elements per worker",
-              [("", snap["compression_ratio"])])
+              [(_labels(run), snap["compression_ratio"])])
 
     last = snap.get("last", {})
+    guards = snap.get("guards", {})
     for key, (name, help_) in _SCALAR_GAUGES.items():
-        if isinstance(last.get(key), (int, float)):
-            gauge(name, help_, [("", last[key])])
+        value = last.get(key)
+        if not isinstance(value, (int, float)) and key in _GUARD_KEYS:
+            value = guards.get(key)     # newest record carrying guards
+        if isinstance(value, (int, float)):
+            gauge(name, help_, [(_labels(run), value)])
     for key, (name, help_) in _WORKER_GAUGES.items():
         col = last.get(key)
         if isinstance(col, list) and col:
             gauge(name, help_,
-                  [(f'{{worker="{i}"}}', v) for i, v in enumerate(col)])
+                  [(_labels(run, worker=i), v) for i, v in enumerate(col)])
 
     summary = snap.get("summary", {})
     gauge("dgc_desync_alerts",
           "desync detector alerts across monitored mass metrics",
-          [("", summary.get("desync_alerts", 0))])
+          [(_labels(run), summary.get("desync_alerts", 0))])
+    if "flight" in snap:
+        gauge("dgc_flight_dump",
+              "1 when a flight-recorder dump sits next to the run",
+              [(_labels(run), 1)])
     if "supervise_launches" in snap:
         gauge("dgc_supervise_launches",
               "trainer launches recorded by the restart supervisor",
-              [("", snap["supervise_launches"])])
+              [(_labels(run), snap["supervise_launches"])])
+
+
+def _render_families(families: Dict) -> str:
+    lines: List[str] = []
+    for name, (help_, samples) in families.items():
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for labels, value in samples:
+            lines.append(f"{name}{labels} {_fmt(value)}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+def render_openmetrics(snap: Dict) -> str:
+    """OpenMetrics text exposition for one snapshot — gauges only, each
+    with HELP/TYPE, every sample labeled ``run="…"`` (per-worker series
+    also ``worker="i"``), ``# EOF`` terminated."""
+    families: Dict = {}
+    _snap_samples(snap, families)
+    return _render_families(families)
+
+
+def render_openmetrics_fleet(fsnap: Dict) -> str:
+    """ONE merged exposition for a fleet snapshot: every family is
+    declared once and carries one sample per run (distinguished by the
+    ``run`` label), plus fleet-level gauges — run count, collection
+    errors, and per-run control-plane action counts."""
+    families: Dict = {}
+    runs = fsnap.get("runs", {})
+    ok = {n: s for n, s in runs.items() if "error" not in s}
+    for name in sorted(ok):
+        _snap_samples(ok[name], families)
+    families.setdefault(
+        "dgc_runs", ("runs discovered under the fleet root",
+                     []))[1].append(("", len(runs)))
+    families.setdefault(
+        "dgc_runs_unreadable",
+        ("runs whose telemetry could not be collected this scrape",
+         []))[1].append(("", len(runs) - len(ok)))
+    counts: Dict[str, int] = {}
+    for e in fsnap.get("control", []):
+        if e.get("event") == "control_action":
+            label = e.get("run_id") or e.get("run", "?")
+            counts[label] = counts.get(label, 0) + 1
+    if counts:
+        families.setdefault(
+            "dgc_control_actions",
+            ("control-plane remediation actions fired per run", []))[1] \
+            .extend((_labels(r), n) for r, n in sorted(counts.items()))
+    return _render_families(families)
 
 
 def _event_line(e: Dict) -> str:
@@ -253,11 +378,22 @@ def render_status(snap: Dict) -> str:
         row2.append(f"torn-lines-skipped {snap['skipped_lines']}")
     if row2:
         lines.append("   " + "  ".join(row2))
-    guards = [f"{k}={last[k]:.4g}" for k in
-              ("skipped_steps", "nonfinite_rate", "checksum_failures")
-              if isinstance(last.get(k), (int, float))]
-    if guards:
-        lines.append("   guards: " + "  ".join(guards))
+    gvals = snap.get("guards") or {
+        k: last[k] for k in _GUARD_KEYS
+        if isinstance(last.get(k), (int, float))}
+    if gvals:
+        tripped = any(v for v in gvals.values())
+        lines.append(("   GUARD TRIPS: " if tripped else "   guards: ")
+                     + "  ".join(f"{k}={v:.4g}"
+                                 for k, v in gvals.items()))
+    flight = snap.get("flight")
+    if flight:
+        t = flight.get("t_dump")
+        when = time.strftime("%H:%M:%S", time.localtime(t)) if t else "--"
+        lines.append(f"   FLIGHT DUMP @{when}: "
+                     f"reason={flight.get('reason')!r} "
+                     f"records={flight.get('records', '?')} "
+                     f"({flight.get('path', 'flight.json')})")
 
     table = snap.get("straggler_table") or []
     if table:
@@ -300,6 +436,137 @@ def render_status(snap: Dict) -> str:
 
 
 # --------------------------------------------------------------------- #
+# fleet mode                                                             #
+# --------------------------------------------------------------------- #
+
+def read_control_events(fleet_root: str) -> List[Dict]:
+    """Tolerantly read the control plane's fleet-wide event stream
+    (``control_events.jsonl`` under the fleet root)."""
+    path = os.path.join(fleet_root, CONTROL_EVENTS)
+    if not os.path.isfile(path):
+        return []
+    out: List[Dict] = []
+    with open(path) as fh:
+        for ln in fh:
+            if not ln.strip():
+                continue
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def collect_fleet(fleet_root: str, *, rate_window: int = 50) -> Dict:
+    """One snapshot of every run under a fleet root. Tolerant per run: a
+    run whose telemetry cannot be read yields ``{"error": ...}`` instead
+    of poisoning the rest of the fleet."""
+    snaps: Dict[str, Dict] = {}
+    for name, path in sorted(_fleet.discover_runs(fleet_root).items()):
+        try:
+            snaps[name] = collect(path, rate_window=rate_window)
+        except (OSError, ValueError) as e:
+            snaps[name] = {"run": path, "run_label": name,
+                           "error": f"{type(e).__name__}: {e}"}
+    return {"root": fleet_root, "t_collect": time.time(), "runs": snaps,
+            "control": read_control_events(fleet_root)}
+
+
+def rank_runs(fsnap: Dict) -> List[Dict]:
+    """Health-ranked fleet rows, WORST first — the operator's reading
+    order. Score starts at 100 and sheds points for, in decreasing
+    weight: unreadable telemetry, quarantine evidence (flight dump /
+    exit-70 / giveup), desync alerts, guard trips, a persistent
+    straggler, and a stalled step rate."""
+    rows: List[Dict] = []
+    control_by_run: Dict[str, Dict] = {}
+    for e in fsnap.get("control", []):
+        if e.get("event") == "control_action":
+            control_by_run[e.get("run", "?")] = e
+    for name, snap in fsnap.get("runs", {}).items():
+        row: Dict = {"name": name, "last_control": control_by_run.get(name)}
+        if "error" in snap:
+            rows.append(dict(row, score=0, verdict="unreadable",
+                             error=snap["error"]))
+            continue
+        score = 100
+        notes = []
+        last_sup = snap.get("last_supervise") or {}
+        if snap.get("flight"):
+            score -= 50
+            notes.append("flight-dump")
+        if (last_sup.get("event") in ("quarantined", "giveup")
+                or last_sup.get("rc") == 70):
+            score -= 50
+            notes.append(last_sup.get("event") or "rc70")
+        summary = snap.get("summary") or {}
+        if summary.get("desync_alerts"):
+            score -= 40
+            notes.append(f"desync x{summary['desync_alerts']}")
+        guards = snap.get("guards") or {}
+        if any(guards.get(k) for k in _GUARD_KEYS):
+            score -= 20
+            notes.append("guard-trips")
+        share = summary.get("straggler_share")
+        if share is not None and share >= 1.5:
+            score -= 15
+            notes.append(f"straggler w{summary.get('straggler')} "
+                         f"x{share:.2f}")
+        if not snap.get("steps_per_s") and last_sup.get("event") not in \
+                ("done",):
+            score -= 10
+            notes.append("no-rate")
+        rows.append(dict(
+            row, score=max(score, 0),
+            verdict=("healthy" if score >= 80 else
+                     "degraded" if score >= 40 else "critical"),
+            step=snap.get("step"), rate=snap.get("steps_per_s"),
+            world=snap.get("world"), run_label=snap.get("run_label"),
+            launches=snap.get("supervise_launches"),
+            last_supervise=last_sup.get("event"), notes=notes))
+    rows.sort(key=lambda r: (r["score"], r["name"]))
+    return rows
+
+
+def render_fleet_status(fsnap: Dict) -> str:
+    """Terminal fleet view: health-ranked run table (worst first) plus
+    the control plane's most recent remediation actions."""
+    runs = fsnap.get("runs", {})
+    control = fsnap.get("control", [])
+    n_actions = sum(1 for e in control if e.get("event") == "control_action")
+    lines = [
+        f"== dgc fleet control == {fsnap.get('root', '?')}",
+        f"   {len(runs)} runs  {n_actions} control actions",
+        "   health  verdict     run           step    rate/s  launches  "
+        "notes",
+    ]
+    for r in rank_runs(fsnap):
+        if r["verdict"] == "unreadable":
+            lines.append(f"   {r['score']:>6}  {r['verdict']:<10}  "
+                         f"{r['name']:<12}  {r.get('error', '')}")
+            continue
+        rate = f"{r['rate']:.2f}" if isinstance(r.get("rate"),
+                                                (int, float)) else "--"
+        lines.append(
+            f"   {r['score']:>6}  {r['verdict']:<10}  {r['name']:<12}  "
+            f"{str(r.get('step', '--')):>4}  {rate:>8}  "
+            f"{str(r.get('launches', '--')):>8}  "
+            + (", ".join(r["notes"]) if r.get("notes") else "ok"))
+    actions = [e for e in control if e.get("event") == "control_action"]
+    if actions:
+        lines.append("   recent control actions (newest last):")
+        for e in actions[-5:]:
+            ev = e.get("evidence", {})
+            t = e.get("t")
+            when = time.strftime("%H:%M:%S", time.localtime(t)) if t \
+                else "--"
+            lines.append(f"     {when}  {e.get('run')}: "
+                         f"{e.get('rule')} -> {e.get('action')} "
+                         f"(evidence: {ev.get('kind')})")
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------- #
 # server                                                                 #
 # --------------------------------------------------------------------- #
 
@@ -312,8 +579,10 @@ class _Cache:
     errors (e.g. the run dir appearing late) are served as a 503 body
     rather than killing the monitor."""
 
-    def __init__(self, run: str, interval: float):
-        self.run = run
+    def __init__(self, collect_fn, interval: float):
+        if isinstance(collect_fn, str):        # a run path: single-run collect
+            collect_fn = (lambda path: lambda: collect(path))(collect_fn)
+        self._collect = collect_fn
         self.interval = float(interval)
         self._lock = threading.Lock()
         self._snap: Optional[Dict] = None
@@ -325,14 +594,17 @@ class _Cache:
             now = time.monotonic()
             if self._snap is None or now - self._t >= self.interval:
                 try:
-                    self._snap, self._err = collect(self.run), None
+                    self._snap, self._err = self._collect(), None
                 except (OSError, ValueError) as e:
                     self._err = f"{type(e).__name__}: {e}"
                 self._t = now
             return self._snap, self._err
 
 
-def _make_handler(cache: "_Cache"):
+def _make_handler(cache: "_Cache", fleet: bool = False):
+    status_fn = render_fleet_status if fleet else render_status
+    metrics_fn = render_openmetrics_fleet if fleet else render_openmetrics
+
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
             snap, err = cache.snapshot()
@@ -340,10 +612,10 @@ def _make_handler(cache: "_Cache"):
                 body, code, ct = (err or "no data") + "\n", 503, \
                     "text/plain; charset=utf-8"
             elif self.path.rstrip("/") in ("", "/status"):
-                body, code, ct = render_status(snap), 200, \
+                body, code, ct = status_fn(snap), 200, \
                     "text/plain; charset=utf-8"
             elif self.path == "/metrics":
-                body, code, ct = render_openmetrics(snap), 200, \
+                body, code, ct = metrics_fn(snap), 200, \
                     _OPENMETRICS_CT
             else:
                 body, code, ct = "not found\n", 404, \
@@ -362,23 +634,27 @@ def _make_handler(cache: "_Cache"):
 
 
 def serve(run: str, *, port: int = 9100, interval: float = 5.0,
-          max_iterations: Optional[int] = None) -> int:
+          max_iterations: Optional[int] = None, fleet: bool = False) -> int:
     """Serve ``/metrics`` + ``/status`` and print the terminal view every
     ``interval`` seconds until interrupted (``max_iterations`` bounds the
-    loop for tests)."""
-    cache = _Cache(run, interval=min(interval, 5.0))
-    server = ThreadingHTTPServer(("", port), _make_handler(cache))
+    loop for tests). ``fleet=True`` treats ``run`` as a fleet root and
+    serves the merged exposition / health-ranked table."""
+    collect_fn = ((lambda: collect_fleet(run)) if fleet
+                  else (lambda: collect(run)))
+    cache = _Cache(collect_fn, interval=min(interval, 5.0))
+    server = ThreadingHTTPServer(("", port), _make_handler(cache, fleet))
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="dgc-monitor-http")
     thread.start()
     print(f"[monitor] serving /metrics + /status on "
           f"http://0.0.0.0:{server.server_address[1]}  (ctrl-c to stop)",
           flush=True)
+    status_fn = render_fleet_status if fleet else render_status
     n = 0
     try:
         while max_iterations is None or n < max_iterations:
             snap, err = cache.snapshot()
-            print(render_status(snap) if snap is not None
+            print(status_fn(snap) if snap is not None
                   else f"[monitor] waiting for telemetry: {err}",
                   flush=True)
             n += 1
@@ -396,7 +672,8 @@ def _main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dgc_tpu.telemetry.monitor",
         description="live fleet monitor over a telemetry run directory")
-    ap.add_argument("run", help="run dir (or telemetry dir / .jsonl file)")
+    ap.add_argument("run", help="run dir (or telemetry dir / .jsonl file; "
+                                "a fleet root with --fleet)")
     ap.add_argument("--port", type=int, default=9100,
                     help="OpenMetrics endpoint port (0 = ephemeral)")
     ap.add_argument("--interval", type=float, default=5.0,
@@ -406,17 +683,26 @@ def _main(argv=None) -> int:
     ap.add_argument("--openmetrics", action="store_true",
                     help="with --once: print the /metrics exposition "
                          "instead of the status view")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat RUN as a fleet root of run dirs: merged "
+                         "per-run-labeled /metrics, health-ranked status")
     args = ap.parse_args(argv)
     if args.once:
         try:
-            snap = collect(args.run)
+            snap = (collect_fleet(args.run) if args.fleet
+                    else collect(args.run))
         except (OSError, ValueError) as e:
             print(f"[monitor] {type(e).__name__}: {e}")
             return 1
-        print(render_openmetrics(snap) if args.openmetrics
-              else render_status(snap), end="")
+        if args.fleet:
+            print(render_openmetrics_fleet(snap) if args.openmetrics
+                  else render_fleet_status(snap), end="")
+        else:
+            print(render_openmetrics(snap) if args.openmetrics
+                  else render_status(snap), end="")
         return 0
-    return serve(args.run, port=args.port, interval=args.interval)
+    return serve(args.run, port=args.port, interval=args.interval,
+                 fleet=args.fleet)
 
 
 if __name__ == "__main__":

@@ -12,10 +12,11 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 __all__ = [
     "SCHEMA", "SCHEMA_VERSION", "MetricSpec", "STEP_METRICS", "RUN_METRICS",
-    "GUARD_METRICS", "FLEET_METRICS", "step_stat_names", "guard_stat_names",
-    "fleet_stat_names", "spec_by_name", "step_out_specs", "guard_out_specs",
-    "fleet_out_specs", "make_header", "validate_step_stats",
-    "validate_guard_stats", "validate_fleet_stats",
+    "GUARD_METRICS", "FLEET_METRICS", "CONTROL_ACTIONS", "step_stat_names",
+    "guard_stat_names", "fleet_stat_names", "control_action_names",
+    "spec_by_name", "step_out_specs", "guard_out_specs", "fleet_out_specs",
+    "make_header", "validate_step_stats", "validate_guard_stats",
+    "validate_fleet_stats", "validate_control_action",
 ]
 
 #: schema family tag written into every sink header
@@ -118,6 +119,28 @@ FLEET_METRICS: Tuple[MetricSpec, ...] = (
                "dispersion (max - min) / max(|mean|, eps)", better="lower"),
 )
 
+#: remediations the control plane (dgc_tpu.control, ISSUE 12) may take on a
+#: supervised run. Declared here so the audit trail is schema-checked like
+#: every other record stream: each fired rule appends one ``control_action``
+#: event (see ``validate_control_action``) to the fleet event stream, and the
+#: action name must be one of these specs. ``better`` reads as "fewer is
+#: healthier" — a fleet firing many actions is a fleet in trouble.
+CONTROL_ACTIONS: Tuple[MetricSpec, ...] = (
+    MetricSpec("restart", "action",
+               "SIGTERM the run's child so it emergency-saves and exits 75, "
+               "then relaunch it with the same cohort spec — the desync "
+               "remediation", better="lower"),
+    MetricSpec("elastic_relaunch", "action",
+               "publish an updated cohort spec through the supervisor's "
+               "--env-file, then restart so the relaunch restores elastically "
+               "(W -> W' reshard) under the new cohort — the straggler / "
+               "cohort-shrink remediation", better="lower"),
+    MetricSpec("quarantine", "action",
+               "stop relaunching the run but keep its artifacts (telemetry, "
+               "flight.json, checkpoints) for post-mortem — the "
+               "nonfinite-streak / flight-dump remediation", better="lower"),
+)
+
 #: run-level summary keys the regression gate compares (step time and
 #: overhead come from bench records; wire volume from either source).
 RUN_METRICS: Tuple[MetricSpec, ...] = (
@@ -164,6 +187,10 @@ def guard_stat_names() -> Tuple[str, ...]:
 
 def fleet_stat_names() -> Tuple[str, ...]:
     return tuple(s.name for s in FLEET_METRICS)
+
+
+def control_action_names() -> Tuple[str, ...]:
+    return tuple(s.name for s in CONTROL_ACTIONS)
 
 
 def spec_by_name() -> Dict[str, MetricSpec]:
@@ -220,6 +247,26 @@ def validate_fleet_stats(stats: Dict) -> None:
         raise ValueError(
             f"fleet stats drifted from the registry schema: "
             f"missing={sorted(want - got)} extra={sorted(got - want)}")
+
+
+def validate_control_action(record: Dict) -> None:
+    """Schema check for one ``control_action`` audit event before it hits
+    the fleet event stream. Every action must be attributable: which run,
+    which rule, which remediation, and the evidence that triggered it."""
+    if record.get("event") != "control_action":
+        raise ValueError(
+            f"control_action record has event={record.get('event')!r}")
+    missing = [k for k in ("run", "run_id", "rule", "action", "evidence", "t")
+               if k not in record]
+    if missing:
+        raise ValueError(
+            f"control_action record missing keys: {missing}")
+    if record["action"] not in control_action_names():
+        raise ValueError(
+            f"unknown control action {record['action']!r} "
+            f"(known: {list(control_action_names())})")
+    if not isinstance(record["evidence"], dict) or not record["evidence"]:
+        raise ValueError("control_action evidence must be a non-empty dict")
 
 
 def make_header(static: Optional[Dict] = None,

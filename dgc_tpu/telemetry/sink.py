@@ -35,10 +35,49 @@ import numpy as np
 
 from dgc_tpu.telemetry import registry
 
-__all__ = ["TelemetrySink", "SchemaMismatchError", "read_run",
-           "read_run_tolerant", "summarize", "to_csv"]
+__all__ = ["TelemetrySink", "JsonlAppender", "SchemaMismatchError",
+           "read_run", "read_run_tolerant", "summarize", "to_csv"]
 
 _CLOSE = object()
+
+
+class JsonlAppender:
+    """Append-only JSONL event stream, flushed per record.
+
+    The supervisor and control-plane event streams share this writer: a
+    tailing reader (the live monitor, the control plane's audit trail)
+    must see every event the moment it is written, relaunch churn must
+    not reopen the file hundreds of times, and writers on several
+    threads (one supervisor thread per run) must not interleave lines.
+    The file is opened lazily on the first write and appended to, so a
+    relaunched supervisor extends the same stream."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self._fh = None
+        self._lock = threading.Lock()
+
+    def write(self, record: Dict[str, Any]) -> str:
+        line = json.dumps(record)
+        with self._lock:
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._fh = open(self.path, "a")
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        return line
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class SchemaMismatchError(ValueError):

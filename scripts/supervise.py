@@ -2,247 +2,34 @@
 """Restart supervisor for elastic training (docs/RESILIENCE.md §"Elastic
 restart").
 
-Wraps the training entrypoint in a bounded retry / exponential-backoff
-relaunch loop:
+Thin CLI over :mod:`dgc_tpu.control.supervisor` — the launch / backoff /
+progress-watch loop lives there as the importable ``Supervisor`` class so
+the control plane (:mod:`dgc_tpu.control.plane`) can supervise many runs
+at once. This script keeps the original single-run surface:
 
     python scripts/supervise.py --retries 5 --watch /runs/exp.npE/checkpoints \
         --env-file /runs/exp.cohort.env -- \
         python train.py --configs ... configs/resilience.py configs/elastic.py
 
-Each launch is a FRESH process, so ``initialize_multihost`` re-runs its
-cohort agreement from scratch — the relaunched trainer resolves the new
-world size from the (re-read) environment, restores the newest
-checkpoint, reshards the per-worker DGC state across any world-size
-change (``--elastic``), and resumes mid-epoch from the recorded batch
-cursor. The supervisor itself never touches jax: it only re-execs,
-backs off, and keeps score.
-
-Mechanics:
-
-* ``--env-file`` is re-read before EVERY launch and its ``KEY=VALUE``
-  lines override the child environment — the cluster manager's hook for
-  publishing a new cohort spec (``JAX_COORDINATOR_ADDRESS`` /
-  ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``) after a slice comes back
-  with a different shape.
-* a child exit code in ``--success-codes`` (default ``0``) ends the
-  loop successfully; anything else relaunches. Exit code 75
-  (EX_TEMPFAIL) is the convention for "preempted after a clean
-  emergency save — relaunch me".
-* retries are budgeted against *progress*: when ``--watch`` names the
-  checkpoint directory and its ``latest.json`` changed since the last
-  launch (an emergency save counts), the failure counter resets — a
-  preempted-but-saving run relaunches indefinitely, while a run that
-  cannot even reach a save gives up after ``--retries`` consecutive
-  failures.
-* SIGTERM/SIGINT to the supervisor forwards to the child and STOPS the
-  relaunch loop (the scheduler wants us gone, not respawning).
-* one JSONL event stream (``--events-out``; legacy alias ``--events``)
-  records every launch, exit, backoff, and the final verdict, for
-  postmortems, the smoke test, and the live monitor
-  (``python -m dgc_tpu.telemetry.monitor``). When unset it defaults to
-  ``supervise_events.jsonl`` next to the ``--watch`` checkpoint dir —
-  i.e. under the run dir, where the monitor looks for it. Every event is
-  stamped with a per-supervisor ``run_id`` and the cohort spec
-  (``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` /
-  ``JAX_COORDINATOR_ADDRESS``) from the latest env read, and the stream
-  is flushed per event so a tailing reader never waits on a buffer.
+Flag surface, event schema, and mechanics (env-file re-read per launch,
+progress-budgeted retries, SIGTERM/SIGINT forward-and-stop, per-event
+flushed JSONL stream) are pinned by tests/test_control.py's compat test —
+change them in dgc_tpu/control/supervisor.py, not here.
 """
 
-import argparse
-import json
 import os
-import signal
-import subprocess
 import sys
-import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def parse_env_file(path):
-    """KEY=VALUE lines (blank lines and ``#`` comments ignored)."""
-    out = {}
-    if not path or not os.path.exists(path):
-        return out
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#") or "=" not in line:
-                continue
-            k, v = line.split("=", 1)
-            out[k.strip()] = v.strip()
-    return out
-
-
-def checkpoint_progress(watch_dir):
-    """(epoch, mtime) of ``latest.json``; None when absent/unreadable."""
-    if not watch_dir:
-        return None
-    path = os.path.join(watch_dir, "latest.json")
-    try:
-        with open(path) as f:
-            epoch = json.load(f).get("epoch")
-        return (epoch, os.path.getmtime(path))
-    except (OSError, ValueError):
-        return None
-
-
-#: cohort-spec env keys stamped into every event (the monitor's view of
-#: the world shape each launch ran under)
-COHORT_KEYS = ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
-               "JAX_COORDINATOR_ADDRESS")
-
-
-def default_events_path(watch):
-    """``supervise_events.jsonl`` next to the watched checkpoint dir —
-    i.e. under the run dir, where the live monitor looks for it."""
-    if not watch:
-        return None
-    return os.path.join(os.path.dirname(os.path.abspath(watch)),
-                        "supervise_events.jsonl")
-
-
-class Supervisor:
-    def __init__(self, cmd, retries=5, backoff=5.0, backoff_max=300.0,
-                 env_file=None, watch=None, events=None,
-                 success_codes=(0,)):
-        self.cmd = list(cmd)
-        self.retries = int(retries)
-        self.backoff = float(backoff)
-        self.backoff_max = float(backoff_max)
-        self.env_file = env_file
-        self.watch = watch
-        self.events_path = events
-        self.success_codes = set(success_codes)
-        self.child = None
-        self.shutting_down = False
-        self.launches = 0
-        # one id per supervisor lifetime: every relaunch of this run
-        # shares it, a fresh supervisor gets a fresh one
-        self.run_id = time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}"
-        self.cohort = {k: os.environ.get(k) for k in COHORT_KEYS
-                       if os.environ.get(k) is not None}
-        self._events_fh = None
-
-    def event(self, kind, **fields):
-        rec = dict(fields, event=kind, t=time.time(),
-                   launches=self.launches, run_id=self.run_id,
-                   cohort=self.cohort)
-        line = json.dumps(rec)
-        print(f"[supervise] {line}", flush=True)
-        if self.events_path:
-            # persistent handle, flushed per event: a tailing monitor
-            # sees every launch/relaunch as it happens, and relaunch
-            # churn doesn't reopen the file hundreds of times
-            if self._events_fh is None:
-                d = os.path.dirname(os.path.abspath(self.events_path))
-                os.makedirs(d, exist_ok=True)
-                self._events_fh = open(self.events_path, "a")
-            self._events_fh.write(line + "\n")
-            self._events_fh.flush()
-
-    def _forward(self, signum, frame):
-        # the scheduler is tearing US down: stop relaunching, pass the
-        # signal through so the child takes its emergency-save path
-        self.shutting_down = True
-        if self.child is not None and self.child.poll() is None:
-            try:
-                self.child.send_signal(signum)
-            except OSError:
-                pass
-
-    def run(self):
-        for s in (signal.SIGTERM, signal.SIGINT):
-            signal.signal(s, self._forward)
-        failures = 0
-        while True:
-            env = dict(os.environ)
-            overrides = parse_env_file(self.env_file)
-            env.update(overrides)
-            # latest cohort spec (the env-file may have re-shaped the
-            # world since the last launch) rides every event from here on
-            self.cohort = {k: env.get(k) for k in COHORT_KEYS
-                           if env.get(k) is not None}
-            before = checkpoint_progress(self.watch)
-            self.launches += 1
-            self.event("launch", cmd=self.cmd,
-                       world=env.get("JAX_NUM_PROCESSES"),
-                       env_overrides=sorted(overrides))
-            t0 = time.time()
-            self.child = subprocess.Popen(self.cmd, env=env)
-            rc = self.child.wait()
-            self.child = None
-            elapsed = time.time() - t0
-            if rc in self.success_codes:
-                self.event("done", rc=rc, elapsed=elapsed)
-                return 0
-            after = checkpoint_progress(self.watch)
-            progressed = after is not None and after != before
-            if progressed:
-                # visible checkpoint progress (a preemption's emergency
-                # save included) is not a failure: the retry budget
-                # guards against crash loops, not against preemptions
-                failures = 0
-            else:
-                failures += 1
-            if self.shutting_down:
-                self.event("stopped", rc=rc, reason="signal")
-                return rc
-            if failures > self.retries:
-                self.event("giveup", rc=rc, failures=failures,
-                           retries=self.retries)
-                return rc
-            delay = min(self.backoff * (2 ** max(failures - 1, 0)),
-                        self.backoff_max)
-            self.event("relaunch", rc=rc, elapsed=elapsed,
-                       failures=failures, delay=delay,
-                       progressed=progressed)
-            time.sleep(delay)
-
-
-def main(argv=None):
-    parser = argparse.ArgumentParser(
-        description=__doc__.splitlines()[0],
-        usage="supervise.py [options] -- <training command ...>")
-    parser.add_argument("--retries", type=int, default=5,
-                        help="consecutive no-progress failures before "
-                             "giving up (progress resets the count)")
-    parser.add_argument("--backoff", type=float, default=5.0,
-                        help="initial relaunch delay, doubled per "
-                             "consecutive failure")
-    parser.add_argument("--backoff-max", type=float, default=300.0)
-    parser.add_argument("--env-file", default=None,
-                        help="KEY=VALUE file re-read before every launch; "
-                             "overrides the child environment (new cohort "
-                             "spec goes here)")
-    parser.add_argument("--watch", default=None,
-                        help="checkpoint directory; progress in its "
-                             "latest.json resets the retry budget")
-    parser.add_argument("--events-out", default=None,
-                        help="append one JSON line per supervisor event; "
-                             "defaults to supervise_events.jsonl next to "
-                             "the --watch dir (under the run dir)")
-    parser.add_argument("--events", default=None,
-                        help="legacy alias for --events-out (takes "
-                             "precedence when both are given)")
-    parser.add_argument("--success-codes", default="0",
-                        help="comma-separated child exit codes that end "
-                             "the loop successfully")
-    parser.add_argument("cmd", nargs=argparse.REMAINDER,
-                        help="-- then the training command")
-    args = parser.parse_args(argv)
-    cmd = args.cmd
-    if cmd and cmd[0] == "--":
-        cmd = cmd[1:]
-    if not cmd:
-        parser.error("no training command given (put it after --)")
-    events = (args.events or args.events_out
-              or default_events_path(args.watch))
-    sup = Supervisor(
-        cmd, retries=args.retries, backoff=args.backoff,
-        backoff_max=args.backoff_max, env_file=args.env_file,
-        watch=args.watch, events=events,
-        success_codes={int(c) for c in args.success_codes.split(",")})
-    return sup.run()
-
+from dgc_tpu.control.supervisor import (  # noqa: E402,F401 — re-exported:
+    COHORT_KEYS,                          # tests and tooling import these
+    Supervisor,                           # names from this script's path
+    checkpoint_progress,
+    default_events_path,
+    main,
+    parse_env_file,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -59,6 +59,16 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "MONITOR_SMOKE=ok" || { echo "MONITOR_SMOKE=FAIL"; rc=1; }
+# control-plane smoke (docs/TELEMETRY.md §"Control plane"): supervise.py
+# CLI flag/event-schema compat pin, rule-engine debounce/budget hygiene,
+# fleet-root discovery with torn shards, and the multi-run drill — a
+# ControlPlane over concurrent fake runs with an injected straggler,
+# offline residual corruption, and a nonfinite abort; the rule engine
+# must elastic-relaunch / restart / quarantine exactly the offending
+# runs and leave the healthy run untouched
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_control.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "CONTROL_SMOKE=ok" || { echo "CONTROL_SMOKE=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite — nonzero on any un-allowlisted finding
 # or broken step invariant (one sparse exchange, telemetry compiles away,

@@ -1,0 +1,156 @@
+"""Fake trainer for the control-plane drill (tests/test_control.py).
+
+Writes a fleet-schema telemetry run — ``<run_dir>/telemetry/host0/
+telemetry.jsonl`` flushed per record, checkpoint progress in
+``<run_dir>/checkpoints/latest.json`` — at millisecond cost, no jax, so
+a ControlPlane can supervise several of these concurrently and the rule
+engine sees exactly the signals a real ``train.py`` run emits:
+
+* ``DGC_RUN_ID`` (set by the Supervisor) lands in the header static,
+* ``JAX_NUM_PROCESSES`` (spec env / republished cohort file) lands in
+  ``static.num_processes`` — the cohort spec the relaunch picked up,
+* ``DGC_FAULTS=slow[:ms=M]`` stretches the LAST worker's ``w_clock``
+  lane by M ms (the straggler signature the fleet taps would record),
+* ``DGC_FAKE_DESYNC=<worker>`` walks that worker's ``w_residual_mass``
+  away from the cohort band after a third of the run (offline residual
+  corruption),
+* ``DGC_FAKE_NONFINITE=<step>`` aborts the nonfinite way at that step:
+  guard counters in the record, a ``dgc-flight`` dump, exit 70,
+* SIGTERM takes the emergency-save path: bump ``latest.json``, exit 75.
+
+Exit codes mirror train.py's conventions (docs/TELEMETRY.md §"Control
+plane"): 0 done, 75 preempted-after-save, 70 nonfinite abort.
+"""
+
+import argparse
+import json
+import os
+import random
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.telemetry import registry  # noqa: E402
+
+
+def parse_slow_ms(tokens):
+    """The ``slow[:ms=M]`` token of DGC_FAULTS (default 100ms)."""
+    for tok in (tokens or "").split(","):
+        tok = tok.strip()
+        if not tok.startswith("slow"):
+            continue
+        ms = 100.0
+        for part in tok.split(":")[1:]:
+            if part.startswith("ms="):
+                ms = float(part[3:])
+        return ms
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run_dir")
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--step-ms", type=float, default=20.0)
+    ap.add_argument("--world", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    run_dir = os.path.abspath(args.run_dir)
+    ckpt_dir = os.path.join(run_dir, "checkpoints")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    shard_dir = os.path.join(run_dir, "telemetry", "host0")
+    os.makedirs(shard_dir, exist_ok=True)
+
+    num_processes = int(os.environ.get("JAX_NUM_PROCESSES") or 1)
+    static = {"world": args.world, "num_params": 1000, "payload_elems": 50,
+              "num_processes": num_processes}
+    run_id = os.environ.get("DGC_RUN_ID")
+    if run_id:
+        static["run_id"] = run_id
+
+    slow_ms = parse_slow_ms(os.environ.get("DGC_FAULTS"))
+    desync = os.environ.get("DGC_FAKE_DESYNC")
+    desync_w = int(desync) if desync else None
+    nonfinite = os.environ.get("DGC_FAKE_NONFINITE")
+    nonfinite_at = int(nonfinite) if nonfinite else None
+    desync_at = max(10, args.steps // 3)
+
+    try:
+        with open(os.path.join(ckpt_dir, "latest.json")) as f:
+            epoch = int(json.load(f).get("epoch", 0))
+    except (OSError, ValueError):
+        epoch = 0
+
+    def save(next_epoch):
+        tmp = os.path.join(ckpt_dir, ".latest.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"epoch": next_epoch}, f)
+        os.replace(tmp, os.path.join(ckpt_dir, "latest.json"))
+
+    fh = open(os.path.join(shard_dir, "telemetry.jsonl"), "w")
+
+    def emit(rec):
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+
+    emit(registry.make_header(static, guards=True, fleet=True))
+
+    def on_term(signum, frame):
+        # the emergency-save path: visible progress, then exit 75 so the
+        # supervisor relaunches without burning its retry budget
+        save(epoch + 1)
+        fh.flush()
+        os._exit(75)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    rng = random.Random(0)
+    for i in range(args.steps):
+        time.sleep(args.step_ms / 1000.0)
+        clock = [10.0 + rng.random() for _ in range(args.world)]
+        if slow_ms is not None:
+            clock[args.world - 1] += slow_ms
+        mass = [100.0 * (1.0 + 0.02 * rng.gauss(0, 1))
+                for _ in range(args.world)]
+        if desync_w is not None and i >= desync_at:
+            mass[desync_w] *= 1.0 + 0.6 * (i - desync_at + 1)
+        rec = {
+            "step": i, "t_host": round(time.time(), 3),
+            "loss": round(2.0 - 0.01 * i, 4),
+            "grad_norm": 1.0, "payload_elems": 50.0,
+            "w_clock": [round(c, 3) for c in clock],
+            "w_grad_norm": [1.0] * args.world,
+            "w_residual_mass": [round(m, 4) for m in mass],
+            "w_sent_ratio": [0.05] * args.world,
+            "straggler": float(max(range(args.world),
+                                   key=lambda w: clock[w])),
+            "straggler_gap": round(max(clock) - min(clock), 3),
+            "worker_skew": 0.1,
+        }
+        if nonfinite_at is not None and i >= nonfinite_at:
+            rec.update(skipped_steps=3.0, nonfinite_rate=1.0,
+                       checksum_failures=0.0, loss=None)
+            emit(rec)
+            from dgc_tpu.telemetry.flight import FlightRecorder
+            fl = FlightRecorder(capacity=16, static=static)
+            fl.record(step=i, loss=float("nan"))
+            fl.dump(os.path.join(run_dir, "flight.json"),
+                    reason=f"nonfinite-streak x3 at step {i}")
+            fh.flush()
+            return 70
+        emit(rec)
+        if i and i % 5 == 0:
+            epoch += 1
+            save(epoch)
+    save(epoch + 1)
+    emit({"event": "run_done", "t_host": round(time.time(), 3),
+          "steps": args.steps})
+    fh.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

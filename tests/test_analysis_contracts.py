@@ -176,6 +176,7 @@ def test_contract_suite_all_green(suite_results):
     "fused-epilogue-no-opt-barriers",
     "recompile-guard-same-shapes",
     "shard-state-collective-free",
+    "control-plane-host-only",
 ])
 def test_suite_covers_named_pin(suite_results, pin):
     assert pin in {n for n, _ in suite_results}

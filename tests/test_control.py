@@ -1,0 +1,389 @@
+"""Tests for the fleet control plane (ISSUE 12): the supervise.py compat
+pin, rule-engine debounce/budget hygiene, detector units, fleet-root
+discovery with torn shards, the merged per-run-labeled OpenMetrics
+exposition, and the multi-run control drill — concurrent fake runs with
+an injected straggler, offline residual corruption, and a nonfinite
+abort, where the rule engine must remediate exactly the offending runs
+with the right evidence and leave the healthy run untouched.
+
+Everything here is host-only (subprocesses + JSONL + threads, no jax),
+so the whole file is ``fast``-marked (scripts/t1.sh CONTROL_SMOKE).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dgc_tpu.control import actions, plane as plane_mod, rules
+from dgc_tpu.control.plane import ControlPlane, RunSpec
+from dgc_tpu.control.rules import Rule, RuleEngine
+from dgc_tpu.telemetry import fleet, monitor, registry
+
+from test_fleet import _write_run
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(ROOT, "tests", "control_worker.py")
+
+
+# --------------------------------------------------------------------- #
+# scripts/supervise.py stays a thin CLI: flag surface + event schema     #
+# --------------------------------------------------------------------- #
+
+def _load_supervise():
+    spec = importlib.util.spec_from_file_location(
+        "supervise_compat", os.path.join(ROOT, "scripts", "supervise.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.fast
+def test_supervise_cli_compat(tmp_path):
+    # the script keeps re-exporting the library surface PR-5 tooling and
+    # tests import from its path
+    sup_mod = _load_supervise()
+    for name in ("parse_env_file", "checkpoint_progress", "COHORT_KEYS",
+                 "default_events_path", "Supervisor", "main"):
+        assert hasattr(sup_mod, name), name
+    from dgc_tpu.control import supervisor as lib
+    assert sup_mod.Supervisor is lib.Supervisor
+
+    # pinned flag surface
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "supervise.py"),
+         "--help"], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--retries", "--backoff", "--backoff-max", "--env-file",
+                 "--watch", "--events-out", "--events", "--success-codes"):
+        assert flag in out.stdout, flag
+
+    # pinned event schema through the real CLI entrypoint (in-process)
+    events = tmp_path / "supervise_events.jsonl"
+    rc = sup_mod.main(["--retries", "1", "--backoff", "0.05",
+                       "--events-out", str(events), "--",
+                       sys.executable, "-c", "raise SystemExit(0)"])
+    assert rc == 0
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    assert [r["event"] for r in recs] == ["launch", "done"]
+    for r in recs:
+        assert {"event", "t", "launches", "run_id", "cohort"} <= set(r)
+    assert recs[0]["cmd"][-1] == "raise SystemExit(0)"
+    assert "env_overrides" in recs[0]
+    assert recs[1]["rc"] == 0 and "elapsed" in recs[1]
+
+
+@pytest.mark.fast
+def test_supervisor_quarantines_exit_70(tmp_path):
+    # the nonfinite-abort convention: exit 70 must NOT be relaunched
+    from dgc_tpu.control.supervisor import Supervisor
+    events = tmp_path / "ev.jsonl"
+    sup = Supervisor([sys.executable, "-c", "raise SystemExit(70)"],
+                     retries=5, backoff=0.05, events=str(events))
+    rc = sup.run(install_signals=False)
+    assert rc == 70
+    assert sup.launches == 1 and sup.state == "quarantined"
+    assert sup.quarantined == "exit:70"
+    kinds = [json.loads(l)["event"] for l in events.read_text().splitlines()]
+    assert kinds == ["launch", "quarantined"]
+
+
+# --------------------------------------------------------------------- #
+# rule engine: persistence, debounce, budget                             #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_rule_engine_debounce_and_budget():
+    rule = Rule("r", lambda s: ({"kind": "x"} if s.get("bad") else None),
+                "restart", min_hits=2, debounce_s=10.0, budget=2)
+    eng = RuleEngine((rule,))
+    bad, ok = {"bad": True}, {}
+
+    assert eng.evaluate("a", bad, now=0.0) == []          # 1 hit < min_hits
+    fired = eng.evaluate("a", bad, now=1.0)               # persistent: fire
+    assert [r.name for r, _ in fired] == ["r"]
+    assert fired[0][1] == {"kind": "x", "hits": 2, "firing": 1}
+    assert eng.evaluate("a", bad, now=2.0) == []          # debounced
+    assert eng.suppressed[("a", "r")] == 1
+    fired = eng.evaluate("a", bad, now=12.0)              # debounce expired
+    assert fired and fired[0][1]["firing"] == 2
+    assert eng.evaluate("a", bad, now=30.0) == []         # budget exhausted
+    assert eng.suppressed[("a", "r")] == 2
+
+    # consecutive-hit counting resets on a quiet tick
+    assert eng.evaluate("b", bad, now=0.0) == []
+    assert eng.evaluate("b", ok, now=1.0) == []
+    assert eng.evaluate("b", bad, now=2.0) == []          # back to 1 hit
+    fired = eng.evaluate("b", bad, now=3.0)
+    assert fired and fired[0][1]["hits"] == 2
+
+    # a crashing detector reads as "no evidence", never raises
+    boom = Rule("boom", lambda s: 1 / 0, "restart", min_hits=1)
+    assert RuleEngine((boom,)).evaluate("a", bad, now=0.0) == []
+
+
+@pytest.mark.fast
+def test_default_rules_match_registry_and_actions():
+    table = rules.default_rules()
+    names = [r.name for r in table]
+    assert names[0] == "nonfinite-quarantine"   # quarantine outranks all
+    for r in table:
+        assert r.action in registry.control_action_names(), r.name
+        assert r.action in actions.ACTIONS, r.name
+
+
+@pytest.mark.fast
+def test_detectors_on_synthetic_snapshots():
+    assert rules.detect_desync({}) is None
+    ev = rules.detect_desync({"summary": {
+        "desync_alerts": 4, "desync_workers": [2],
+        "desync_first": {"step": 30}}})
+    assert ev["kind"] == "desync" and ev["workers"] == [2]
+
+    assert rules.detect_straggler({"summary": {
+        "straggler_share": 1.1, "straggler_gap": 80.0, "straggler": 3}}) \
+        is None                                        # share under floor
+    ev = rules.detect_straggler({"summary": {
+        "straggler_share": 8.0, "straggler_gap": 80.0, "straggler": 3}})
+    assert ev["kind"] == "straggler" and ev["worker"] == 3
+
+    ev = rules.detect_quarantine({"flight": {"reason": "nonfinite-streak",
+                                             "records": 16}})
+    assert ev["kind"] == "flight_dump"
+    ev = rules.detect_quarantine({"last_supervise": {"event": "quarantined",
+                                                     "rc": 70}})
+    assert ev["kind"] == "nonfinite_abort" and ev["rc"] == 70
+    ev = rules.detect_quarantine({"guards": {"nonfinite_rate": 1.0,
+                                             "skipped_steps": 3}})
+    assert ev["kind"] == "nonfinite_rate"
+    assert rules.detect_quarantine({"guards": {"nonfinite_rate": 0.0}}) \
+        is None
+
+    ev = rules.detect_cohort_shrink({"num_hosts": 1,
+                                     "static": {"num_processes": 2}})
+    assert ev == {"kind": "cohort_shrink", "live_hosts": 1,
+                  "spec_processes": 2}
+    assert rules.detect_cohort_shrink({"num_hosts": 2,
+                                       "static": {"num_processes": 2}}) \
+        is None
+
+
+@pytest.mark.fast
+def test_publish_env_merges_atomically(tmp_path):
+    path = tmp_path / "cohort.env"
+    path.write_text("# seed\nJAX_NUM_PROCESSES=2\nJAX_COORDINATOR_ADDRESS"
+                    "=h0:1234\n")
+    merged = actions.publish_env(str(path),
+                                 {"JAX_NUM_PROCESSES": "1"})
+    assert merged == {"JAX_NUM_PROCESSES": "1",
+                      "JAX_COORDINATOR_ADDRESS": "h0:1234"}
+    from dgc_tpu.control.supervisor import parse_env_file
+    assert parse_env_file(str(path)) == merged
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith(".cohort.")]     # no temp litter
+
+
+# --------------------------------------------------------------------- #
+# fleet-root discovery + merged exposition                               #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_discover_runs_and_fleet_collect_with_torn_shards(tmp_path):
+    root = str(tmp_path)
+    _write_run(os.path.join(root, "runA"), hosts=1, world=4, steps=10)
+    _write_run(os.path.join(root, "runB"), hosts=2, world=4, steps=10,
+               torn=True)
+    # a run whose only shard has a torn HEADER: discovered, unreadable
+    bad = os.path.join(root, "runC", "telemetry", "host0")
+    os.makedirs(bad)
+    with open(os.path.join(bad, "telemetry.jsonl"), "w") as f:
+        f.write('{"schema": "dgc-telem')
+    # event streams and loose files at the root must not become runs
+    with open(os.path.join(root, "control_events.jsonl"), "w") as f:
+        f.write(json.dumps({"event": "plane_start", "t": 1.0}) + "\n")
+    os.makedirs(os.path.join(root, "empty"))
+
+    runs = fleet.discover_runs(root)
+    assert sorted(runs) == ["runA", "runB", "runC"]
+
+    # a single run dir degrades to itself; its host*/ shard dirs and
+    # telemetry/ subdir are never split into fake "runs"
+    assert fleet.discover_runs(os.path.join(root, "runB")) == \
+        {"runB": os.path.join(root, "runB")}
+
+    fsnap = monitor.collect_fleet(root)
+    assert sorted(fsnap["runs"]) == ["runA", "runB", "runC"]
+    assert fsnap["runs"]["runA"]["step"] == 9
+    assert fsnap["runs"]["runB"]["skipped_lines"] == 1    # torn tail
+    assert "error" in fsnap["runs"]["runC"]
+    assert [e["event"] for e in fsnap["control"]] == ["plane_start"]
+
+    om = monitor.render_openmetrics_fleet(fsnap)
+    assert om.endswith("# EOF\n")
+    assert 'dgc_step{run="runA"}' in om
+    assert 'dgc_step{run="runB"}' in om
+    assert 'dgc_worker_clock_ms{run="runA",worker="0"}' in om
+    assert "dgc_runs 3" in om
+    assert "dgc_runs_unreadable 1" in om
+    # merged exposition: each family HELP/TYPE'd exactly once
+    helps = [l.split()[2] for l in om.splitlines()
+             if l.startswith("# HELP")]
+    assert len(helps) == len(set(helps))
+
+    ranked = monitor.rank_runs(fsnap)
+    assert ranked[0]["name"] == "runC"                    # worst first
+    assert ranked[0]["verdict"] == "unreadable"
+    status = monitor.render_fleet_status(fsnap)
+    assert "dgc fleet control" in status and "runC" in status
+
+
+# --------------------------------------------------------------------- #
+# the multi-run drill                                                    #
+# --------------------------------------------------------------------- #
+
+def _worker_cmd(run_dir, steps, step_ms=20):
+    return [sys.executable, WORKER, run_dir,
+            "--steps", str(steps), "--step-ms", str(step_ms)]
+
+
+def _drill_rules():
+    # the shipped detectors and action mapping, tuned to tick-fast for
+    # the drill (production debounce is minutes, not milliseconds)
+    return (
+        Rule("nonfinite-quarantine", rules.detect_quarantine, "quarantine",
+             min_hits=1, debounce_s=0.0, budget=1),
+        Rule("desync-restart", rules.detect_desync, "restart",
+             min_hits=2, debounce_s=5.0, budget=1),
+        Rule("straggler-relaunch", rules.detect_straggler,
+             "elastic_relaunch", min_hits=2, debounce_s=5.0, budget=1),
+    )
+
+
+@pytest.mark.fast
+def test_control_plane_multi_run_drill(tmp_path):
+    root = str(tmp_path)
+    specs = [
+        # worker 3's clock lane stretched 80ms -> straggler ->
+        # elastic relaunch with a shrunken cohort spec
+        RunSpec("slowpoke", _worker_cmd(os.path.join(root, "slowpoke"),
+                                        steps=150),
+                run_dir=os.path.join(root, "slowpoke"),
+                env_file=os.path.join(root, "slowpoke", "cohort.env"),
+                env={"DGC_FAULTS": "slow:ms=80",
+                     "JAX_NUM_PROCESSES": "2"},
+                backoff=0.1),
+        # worker 2's residual mass walks away -> desync -> restart
+        RunSpec("wobbly", _worker_cmd(os.path.join(root, "wobbly"),
+                                      steps=150),
+                run_dir=os.path.join(root, "wobbly"),
+                env={"DGC_FAKE_DESYNC": "2"},
+                backoff=0.1),
+        # no faults: must complete untouched
+        RunSpec("steady", _worker_cmd(os.path.join(root, "steady"),
+                                      steps=40),
+                run_dir=os.path.join(root, "steady"),
+                backoff=0.1),
+    ]
+    plane = ControlPlane(specs, root, rules=_drill_rules(), interval=0.25)
+    final = plane.run(max_ticks=400)
+
+    # every run ended cleanly — the remediations cycled the faulty runs
+    # through emergency save (exit 75) + relaunch, not crash loops
+    assert final["steady"]["rc"] == 0
+    assert final["slowpoke"]["rc"] == 0
+    assert final["wobbly"]["rc"] == 0
+
+    by_run = {}
+    for a in plane.actions:
+        by_run.setdefault(a["run"], []).append(a)
+
+    # the healthy run was untouched: one launch, zero actions
+    assert final["steady"]["launches"] == 1
+    assert "steady" not in by_run
+
+    # straggler -> elastic relaunch of slowpoke ONLY, with the worker
+    # named in the evidence and a shrunken cohort spec published
+    acts = by_run["slowpoke"]
+    assert [a["action"] for a in acts] == ["elastic_relaunch"]
+    ev = acts[0]["evidence"]
+    assert ev["kind"] == "straggler" and ev["worker"] == 3
+    assert ev["share"] >= 1.5 and ev["hits"] >= 2
+    assert acts[0]["result"]["published"] == {"JAX_NUM_PROCESSES": "1"}
+    assert acts[0]["result"]["delivered"] is True
+    from dgc_tpu.control.supervisor import parse_env_file
+    assert parse_env_file(specs[0].env_file) == {"JAX_NUM_PROCESSES": "1"}
+    assert final["slowpoke"]["launches"] == 2
+    # the relaunch picked the published cohort up: the env-file override
+    # beats the spec's baseline env, and the worker recorded it
+    snap = monitor.collect(os.path.join(root, "slowpoke"))
+    assert snap["static"]["num_processes"] == 1
+
+    # desync -> restart of wobbly ONLY, with the corrupted worker named
+    acts = by_run["wobbly"]
+    assert [a["action"] for a in acts] == ["restart"]
+    ev = acts[0]["evidence"]
+    assert ev["kind"] == "desync" and ev["workers"] == [2]
+    assert acts[0]["result"]["delivered"] is True
+    assert final["wobbly"]["launches"] == 2
+
+    # the fleet event stream is the audit trail: plane lifecycle, every
+    # supervisor event re-stamped with its run, every action recorded
+    events = [json.loads(l) for l in open(
+        os.path.join(root, "control_events.jsonl"))]
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "plane_start" and kinds[-1] == "plane_stop"
+    assert kinds.count("control_action") == len(plane.actions) >= 2
+    launches = [e for e in events if e["event"] == "launch"]
+    assert {e["run"] for e in launches} == {"slowpoke", "wobbly", "steady"}
+    for e in events:
+        if e["event"] == "control_action":
+            registry.validate_control_action(e)
+
+    # merged OpenMetrics over the fleet root: every run's gauges under
+    # its own run label (the supervisor run_id), plus the action counts
+    fsnap = monitor.collect_fleet(root)
+    om = monitor.render_openmetrics_fleet(fsnap)
+    for name in ("slowpoke", "wobbly", "steady"):
+        run_id = plane.supervisors[name].run_id
+        assert f'dgc_step{{run="{run_id}"}}' in om, name
+    assert "dgc_control_actions{" in om
+    assert "dgc_runs 3" in om
+    # the fleet status ranks the remediated runs' evidence visibly
+    status = monitor.render_fleet_status(fsnap)
+    assert "control actions" in status
+
+
+@pytest.mark.fast
+def test_control_plane_quarantines_nonfinite_run(tmp_path):
+    root = str(tmp_path)
+    run_dir = os.path.join(root, "cursed")
+    spec = RunSpec("cursed", _worker_cmd(run_dir, steps=60),
+                   run_dir=run_dir,
+                   env={"DGC_FAKE_NONFINITE": "12"}, backoff=0.5)
+    plane = ControlPlane([spec], root, rules=_drill_rules(), interval=0.2)
+    final = plane.run(max_ticks=200)
+
+    # exit 70 -> quarantined: exactly one launch, no relaunch
+    assert final["cursed"]["rc"] == 70
+    assert final["cursed"]["launches"] == 1
+    assert final["cursed"]["state"] == "quarantined"
+
+    # the quarantine is audited with the flight-dump evidence attached
+    acts = [a for a in plane.actions if a["run"] == "cursed"]
+    assert len(acts) == 1 and acts[0]["action"] == "quarantine"
+    assert acts[0]["evidence"]["kind"] == "flight_dump"
+    assert "nonfinite-streak" in acts[0]["evidence"]["reason"]
+
+    # artifacts kept for post-mortem, and the monitor surfaces them
+    assert os.path.isfile(os.path.join(run_dir, "flight.json"))
+    snap = monitor.collect(run_dir)
+    assert snap["flight"]["reason"].startswith("nonfinite-streak")
+    assert snap["guards"]["nonfinite_rate"] == 1.0
+    status = monitor.render_status(snap)
+    assert "FLIGHT DUMP" in status and "GUARD TRIPS" in status
+    om = monitor.render_openmetrics(snap)
+    assert "dgc_flight_dump{" in om
+    assert "dgc_guard_nonfinite_rate{" in om

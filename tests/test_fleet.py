@@ -267,11 +267,15 @@ def test_monitor_collect_and_renderers(tmp_path):
 
     om = monitor.render_openmetrics(snap)
     assert om.endswith("# EOF\n")
-    for needle in ('dgc_worker_clock_ms{worker="0"}',
-                   'dgc_worker_residual_mass{worker="3"}',
+    # every gauge carries the run label (the supervise stream's run_id),
+    # per-worker series add worker="i" alongside it
+    for needle in ('dgc_worker_clock_ms{run="x",worker="0"}',
+                   'dgc_worker_residual_mass{run="x",worker="3"}',
+                   'dgc_step{run="x"}',
                    "dgc_straggler_gap_ms", "dgc_worker_skew",
                    "dgc_compression_ratio", "dgc_supervise_launches"):
         assert needle in om, needle
+    assert snap["run_label"] == "x"
     # every family is HELP/TYPE'd exactly once
     helps = [l.split()[2] for l in om.splitlines()
              if l.startswith("# HELP")]
@@ -298,7 +302,7 @@ def test_monitor_http_endpoint(tmp_path):
             body = r.read().decode()
             assert r.headers["Content-Type"].startswith(
                 "application/openmetrics-text")
-        assert body.endswith("# EOF\n") and "dgc_step " in body
+        assert body.endswith("# EOF\n") and "dgc_step{" in body
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/", timeout=10) as r:
             assert "dgc fleet monitor" in r.read().decode()

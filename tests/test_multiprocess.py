@@ -293,7 +293,7 @@ def test_fleet_two_process_straggler(tmp_path):
     snap = monitor.collect(run_dir)
     om = monitor.render_openmetrics(snap)
     assert om.endswith("# EOF\n")
-    assert 'dgc_worker_clock_ms{worker="7"}' in om
+    assert 'dgc_worker_clock_ms{run="fleetrun",worker="7"}' in om
     assert "dgc_straggler_gap_ms" in om and "dgc_worker_skew" in om
     status = monitor.render_status(snap)
     assert "straggler" in status and "desync: quiet" in status

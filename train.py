@@ -475,12 +475,19 @@ def main():
         else:
             sink_path = os.path.join(configs.train.save_path, "telemetry")
             sink_enabled = jax.process_index() == 0
+        from dgc_tpu.control import resolve_run_id
+        # supervised runs carry the supervisor's run_id (DGC_RUN_ID) so
+        # the telemetry header, supervise stream, and every monitor gauge
+        # agree on which run this is; unsupervised runs omit it and the
+        # monitor falls back to the run dir name
+        run_id = resolve_run_id()
         sink = TelemetrySink(
             sink_path,
             static=dict(flat_setup.engine.telemetry_static(),
                         world=world, num_local_workers=num_local,
                         process_index=jax.process_index(),
-                        num_processes=jax.process_count()),
+                        num_processes=jax.process_count(),
+                        **({"run_id": run_id} if run_id else {})),
             rotate_bytes=int(tcfg.get("rotate_mb", 64)) << 20,
             enabled=sink_enabled,
             guards=guards_cfg is not None, fleet=fleet_on)
@@ -533,11 +540,15 @@ def main():
         fl_steps = int(rcfg.get("flight_steps", 0) or 0)
         if fl_steps > 0:
             from dgc_tpu.telemetry.flight import FlightRecorder
+            from dgc_tpu.control import resolve_run_id
+            fl_run_id = resolve_run_id()
             flight = FlightRecorder(
                 capacity=fl_steps,
                 static=dict(flat_setup.engine.telemetry_static(),
                             world=world, num_local_workers=num_local,
-                            save_path=configs.train.save_path))
+                            save_path=configs.train.save_path,
+                            **({"run_id": fl_run_id} if fl_run_id
+                               else {})))
             flight_path = os.path.join(configs.train.save_path,
                                        "flight.json")
         ns = int(rcfg.get("nonfinite_streak", 0) or 0)
