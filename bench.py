@@ -303,11 +303,19 @@ def main():
         # convert after the loop so readbacks don't stall the dispatches
         skews = [float(r["worker_skew"]) for r in fleet_rows[1:]]
         gaps = [float(r["straggler_gap"]) for r in fleet_rows[1:]]
+        # per-step cohort stall on the slowest worker: max - median of
+        # the prep-interval column — the quantity the adaptive exchange
+        # (resilience.adaptive) exists to shrink; gated lower-is-better
+        stalls = [float(np.max(np.asarray(r["w_clock"]))
+                        - np.median(np.asarray(r["w_clock"])))
+                  for r in fleet_rows[1:]]
         skew_med = statistics.median(skews)
         gap_med = statistics.median(gaps)
+        stall_med = statistics.median(stalls)
         print(f"fleet dispersion over {steps} steps: worker_skew "
               f"median {skew_med:.4g} | straggler_gap median "
-              f"{gap_med:.4g} ms", file=sys.stderr)
+              f"{gap_med:.4g} ms | straggler_stall median "
+              f"{stall_med:.4g} ms", file=sys.stderr)
         print(json.dumps({
             "metric": "fleet_dispersion_resnet20_dgc0.001",
             "value": round(skew_med, 6),
@@ -315,6 +323,7 @@ def main():
             "fleet": {
                 "worker_skew": round(skew_med, 6),
                 "straggler_gap": round(gap_med, 4),
+                "straggler_stall_ms": round(stall_med, 4),
                 "steps": steps,
             },
         }))

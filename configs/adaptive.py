@@ -1,0 +1,40 @@
+"""Straggler-adaptive exchange knob (docs/RESILIENCE.md §Adaptive
+exchange): stack it and a flagged straggler transmits a smaller fraction
+of its per-bucket top-k quota — the withheld mass stays in the DGC
+error-feedback residual and re-enters a later exchange, so the cohort
+stops paying the straggler's full lag without changing what converges:
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/adaptive.py
+
+Pulls in the fleet taps it reads (the policy is a pure in-graph function
+of the gathered ``w_clock`` lane — zero extra collectives, zero
+recompiles, contract-pinned in ``python -m dgc_tpu.analysis --gate``).
+Equivalent switches: ``--adaptive`` or ``DGC_ADAPTIVE=1`` (the control
+plane's ``adapt`` action delivers the env var via the supervisor's
+``--env-file``).
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+# the policy reads the fleet w_clock lane: stack the fleet taps first
+if "telemetry" not in configs.train:
+    configs.train.telemetry = Config()
+    configs.train.telemetry.enabled = True
+    configs.train.telemetry.every = 1
+    configs.train.telemetry.rotate_mb = 64
+configs.train.telemetry.fleet = True
+
+if "adaptive" not in configs.train:
+    configs.train.adaptive = Config()
+configs.train.adaptive.enabled = True
+# ramp tier: engage past this cohort max-min prep gap (ms) ...
+configs.train.adaptive.engage_gap_ms = 100.0
+# ... ramping a lagging worker from 1.0 down to min_frac over ramp_ms
+configs.train.adaptive.min_frac = 0.25
+configs.train.adaptive.ramp_ms = 500.0
+# partial-exchange tier: a worker slower than deadline_factor x the
+# cohort median sends a near-empty (partial_frac) payload that step
+configs.train.adaptive.deadline_factor = 4.0
+configs.train.adaptive.partial_frac = 0.02
+configs.train.adaptive.floor_ms = 1.0

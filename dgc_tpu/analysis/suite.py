@@ -18,6 +18,11 @@ tests exercise:
   the state buffers (param 0 included); donate=False aliases nothing.
 * **fused-apply epilogue is barrier-free**: kernels.payload_apply_bits
   lowers without optimization_barrier ops (PR 1's fused epilogue).
+* **adaptive degradation rides the fleet gather**: adaptive=None on a
+  fleet build is byte-identical to a fleet build that never mentioned
+  adaptive (zero resilience/adaptive code lowers); adaptive=on adds ZERO
+  collectives — the policy reads the already-gathered w_clock lane and
+  masked payload tails keep the wire shapes static.
 * **guards cost nothing when off, no syncs when on**: guards=None is
   byte-identical to a build that never mentioned guards (and lowers zero
   resilience/guard or resilience/preempt code); guards=on (+ checksum)
@@ -118,7 +123,8 @@ def build_fixture(mesh=None, world: int = 8, compressor: str = "dgc",
     setup = make_flat_setup(v, dist, plan=plan)
     state = shard_state(
         make_flat_state(v, dist, setup, world,
-                        guards=step_kwargs.get("guards")),
+                        guards=step_kwargs.get("guards"),
+                        adaptive=step_kwargs.get("adaptive")),
         mesh, dist_opt=dist)
     step = build_train_step(apply_fn, dist, mesh, flat=setup, **step_kwargs)
 
@@ -233,6 +239,33 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         identical_to=_step_contract("fleet-never-built", state,
                                     step_telem, inputs))
     run(foff.name, foff.check)
+
+    # straggler-adaptive exchange (ISSUE 13): adaptive=None on a fleet
+    # build must be byte-identical to a fleet build that never mentioned
+    # adaptive, and no resilience/adaptive code may lower into it
+    _, step_aoff, _, _ = build_fixture(mesh, donate=False, telemetry=True,
+                                       fleet=True, adaptive=None)
+    aoff = Contract(
+        "adaptive-off-compiles-away", step_aoff,
+        args=(state, images_f, labels_f, key_f, clock)).expects(
+        forbid_substrings=["resilience/adaptive"],
+        identical_to=fon)
+    run(aoff.name, aoff.check)
+
+    # adaptive on: the policy reads the already-gathered w_clock lane and
+    # the verdict feeds forward through the donated state, so the whole
+    # feature adds ZERO collectives on top of the fleet build — masked
+    # payload tails keep the wire shapes static (no recompiles either)
+    from dgc_tpu.resilience.adaptive import AdaptiveConfig
+    state_a, step_aon, _, _ = build_fixture(
+        mesh, donate=False, telemetry=True, fleet=True,
+        adaptive=AdaptiveConfig())
+    aon = Contract(
+        "adaptive-on-no-new-collectives", step_aon,
+        args=(state_a, images_f, labels_f, key_f, clock)).expects(
+        collectives_delta=(fon, {"all-gather": 0, "all-reduce": 0}),
+        no_f64=True)
+    run(aon.name, aon.check)
 
     # guards=None must be byte-identical to a build that never mentioned
     # guards (the resilience layer is Python-static), and the plain

@@ -641,6 +641,24 @@ class FlatDGCEngine:
         self._payload_slices = tuple(sl)
         self.payload_size = off
         self.payload_rows = sum(b.rows for b in sparse)
+        #: adaptive-exchange statics (resilience/adaptive.py): per payload
+        #: slot, its importance rank within its row and the row's full
+        #: quota — from the bucket's tight map, so both tight and padded
+        #: layouts are covered (see the _row_map note below). The top-k
+        #: writes each row's selections in descending-|value| order, so
+        #: masking slots with rank >= ceil(quota * send_frac) keeps
+        #: exactly the LARGEST selected elements; at send_frac == 1 every
+        #: structurally valid slot survives and the wire is bitwise
+        #: unchanged.
+        if sparse and self.payload_size:
+            self._adaptive_rank = np.concatenate(
+                [(b.tight % b.max_sel).astype(np.int32) for b in sparse])
+            self._adaptive_quota = np.concatenate(
+                [np.asarray(b.num_selects, np.float32)[b.tight // b.max_sel]
+                 for b in sparse])
+        else:
+            self._adaptive_rank = None
+            self._adaptive_quota = None
         #: kind-local chunk map: sparse bucket j's values ride value lane
         #: self._kinds[j] at [lo, hi) of that lane's concatenated
         #: payload; its indices ride the packed-words or plain-offsets
@@ -1840,9 +1858,22 @@ class FlatDGCEngine:
                  axis_name: str, world_size: int, op: str = "average",
                  local_axis: Optional[str] = None, local_size: int = 1,
                  telemetry: bool = False,
-                 health_out: Optional[Dict] = None):
+                 health_out: Optional[Dict] = None,
+                 send_frac=None):
         """compress -> communicate -> decompress over the whole model:
         two ``all_gather`` + one ``psum`` per step, total.
+
+        ``send_frac`` — straggler-adaptive exchange (docs/RESILIENCE.md
+        §Adaptive exchange): a traced f32 scalar in [0, 1], THIS worker's
+        effective send fraction. After sparsification, each row keeps
+        only its ``ceil(num_selects * send_frac)`` largest selections;
+        the rest are masked to the structural ``(0.0, sentinel)`` pad and
+        dropped from the transmit record, so the withheld mass stays in
+        the local error-feedback residual (mass-conserving, oracle-pinned
+        in tests/test_adaptive.py). Payload shapes are static — zero
+        extra collectives, zero recompiles. ``None`` (the default) is
+        Python-static off: byte-identical program. The dense early path
+        ignores it (a dense psum has no per-worker quota to shrink).
 
         ``health_out`` — mutable out-param dict (the ``stats_out``
         precedent from :meth:`sparsify`): with the engine's payload
@@ -1996,6 +2027,29 @@ class FlatDGCEngine:
         sel_stats: Optional[Dict] = {} if telemetry else None
         values, indices = self.sparsify(comp, key, seg_cands=cands,
                                         stats_out=sel_stats)
+        if send_frac is not None and self._adaptive_rank is not None:
+            # straggler-adaptive masking (resilience/adaptive.py): keep
+            # only each row's ceil(quota * send_frac) largest selections;
+            # the rest become structural (0.0, sentinel) pads — wire
+            # no-ops everywhere downstream (quantize/checksum/scatter),
+            # and DROPPED from the transmit record, so the withheld mass
+            # stays in the velocity buffer for a later exchange. Shapes
+            # are static: no new collectives, no recompiles. At
+            # send_frac == 1.0 the keep mask covers every valid slot and
+            # the wire is bitwise unchanged.
+            fr = jnp.clip(jnp.asarray(send_frac, jnp.float32), 0.0, 1.0)
+            keep = (jnp.asarray(self._adaptive_rank)
+                    < jnp.ceil(jnp.asarray(self._adaptive_quota) * fr))
+            values = jnp.where(keep, values, jnp.zeros((), values.dtype))
+            indices = jnp.where(keep, indices,
+                                jnp.asarray(self.layout.sentinel,
+                                            indices.dtype))
+            if sel_stats is not None:
+                # transmitted elements, post-mask (selection stats like
+                # selected_frac/threshold stay pre-mask by design: they
+                # describe the selection, this describes the wire)
+                sel_stats["payload_elems"] = jnp.sum(
+                    (indices != self.layout.sentinel).astype(jnp.float32))
         if self._dcodec is not None:
             # Elias-Fano precondition: each delta bucket's payload slice
             # sorted by canonical position BEFORE any lane packing, so
@@ -2496,9 +2550,10 @@ class FlatDenseExchange:
     def exchange(self, flat_grad, mem, key, axis_name, world_size,
                  op: str = "average", local_axis: Optional[str] = None,
                  local_size: int = 1, telemetry: bool = False,
-                 health_out: Optional[Dict] = None):
-        # health_out accepted for signature parity with FlatDGCEngine;
-        # the dense psum has no sparse payload to checksum
+                 health_out: Optional[Dict] = None, send_frac=None):
+        # health_out/send_frac accepted for signature parity with
+        # FlatDGCEngine; the dense psum has no sparse payload to checksum
+        # and no per-worker quota for the adaptive policy to shrink
         if telemetry:
             # dense-baseline taps: grad norm only; no sparse payload, no
             # error-feedback state (wire_bytes is the SPARSE wire metric

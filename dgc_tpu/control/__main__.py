@@ -1,6 +1,7 @@
 """Fleet control plane CLI.
 
-    python -m dgc_tpu.control fleet.json [--interval 5] [--max-ticks N]
+    python -m dgc_tpu.control fleet.json [--interval 5] [--max-ticks N] \
+        [--rules rules.toml]
 
 ``fleet.json``::
 
@@ -19,8 +20,12 @@
 Per-run keys mirror :class:`dgc_tpu.control.plane.RunSpec`; ``run_dir``
 defaults to ``<fleet_root>/<name>`` and ``env_file`` to
 ``<run_dir>/cohort.env`` so the elastic-relaunch remediation always has
-a publish target. Exit code is 0 when every run ends successfully, 1
-otherwise. Watch the fleet live with::
+a publish target. The remediation table defaults to the built-in
+:func:`dgc_tpu.control.rules.default_rules`; a ``rules.toml`` next to
+the fleet spec (or ``--rules``) replaces it declaratively
+(:func:`dgc_tpu.control.rules.load_rules`) — the config-first home of
+the ``adapt`` remediation. Exit code is 0 when every run ends
+successfully, 1 otherwise. Watch the fleet live with::
 
     python -m dgc_tpu.telemetry.monitor <fleet_root> --fleet
 """
@@ -70,9 +75,22 @@ def main(argv=None):
                     help="seconds between control ticks")
     ap.add_argument("--max-ticks", type=int, default=None,
                     help="stop the fleet after N control ticks (smoke runs)")
+    ap.add_argument("--rules", default=None,
+                    help="rule-table TOML (default: rules.toml beside the "
+                         "fleet spec when present, else the built-in "
+                         "table)")
     args = ap.parse_args(argv)
     fleet_root, specs = load_fleet(args.fleet)
-    plane = ControlPlane(specs, fleet_root, interval=args.interval)
+    rules = None
+    rules_path = args.rules or os.path.join(
+        os.path.dirname(os.path.abspath(args.fleet)), "rules.toml")
+    if args.rules or os.path.exists(rules_path):
+        from dgc_tpu.control.rules import load_rules
+        rules = load_rules(rules_path)
+        print(f"[control] rule table from {rules_path}: "
+              f"{[r.name for r in rules]}", flush=True)
+    plane = ControlPlane(specs, fleet_root, rules=rules,
+                         interval=args.interval)
     final = plane.run(max_ticks=args.max_ticks)
     bad = {n: v for n, v in final.items() if v["rc"] not in (0, None)}
     print(f"[control] fleet done: {len(final) - len(bad)}/{len(final)} runs "

@@ -20,7 +20,8 @@ from typing import Dict, Optional
 from dgc_tpu.control.supervisor import Supervisor, parse_env_file
 
 __all__ = ["publish_env", "default_cohort_planner", "act_restart",
-           "act_elastic_relaunch", "act_quarantine", "ACTIONS", "execute"]
+           "act_elastic_relaunch", "act_quarantine", "act_adapt",
+           "ACTIONS", "execute"]
 
 
 def publish_env(path: str, updates: Dict[str, str]) -> Dict[str, str]:
@@ -101,11 +102,33 @@ def act_quarantine(sup: Supervisor, evidence: Dict, **_kw) -> Dict:
     return {"quarantined": sup.quarantined, "already": already}
 
 
+def act_adapt(sup: Supervisor, evidence: Dict, **_kw) -> Dict:
+    """Publish ``DGC_ADAPTIVE=1`` through the env-file, then restart so
+    the relaunch runs with the straggler-adaptive exchange engaged
+    (``train.py`` reads the env var; docs/RESILIENCE.md §Adaptive
+    exchange) — the *soft* straggler remediation: the cohort keeps every
+    worker but stops paying the laggard's full lag. Contrast
+    ``elastic_relaunch``, which evicts the worker outright."""
+    result: Dict = {}
+    if sup.env_file:
+        merged = publish_env(sup.env_file, {"DGC_ADAPTIVE": "1"})
+        result.update(env_file=sup.env_file,
+                      published={"DGC_ADAPTIVE": "1"},
+                      cohort_spec={k: merged[k] for k in sorted(merged)})
+    else:
+        # no env-file wired: still restart, but the audit must not claim
+        # the adaptive flag was delivered
+        result.update(published={}, degraded_to="restart")
+    result["delivered"] = sup.request_restart(reason=evidence.get("kind"))
+    return result
+
+
 #: action name (registry.CONTROL_ACTIONS) -> implementation
 ACTIONS = {
     "restart": act_restart,
     "elastic_relaunch": act_elastic_relaunch,
     "quarantine": act_quarantine,
+    "adapt": act_adapt,
 }
 
 

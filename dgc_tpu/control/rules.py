@@ -20,13 +20,19 @@ needs:
 Suppressed firings (debounced or over budget) are counted and visible
 via ``engine.suppressed`` — silence must be attributable too. The engine
 takes ``now`` explicitly so tests drive it with a fake clock.
+
+The table itself can come from a ``rules.toml`` file
+(:func:`load_rules`) so an operator retunes thresholds or wires the
+``adapt`` remediation without touching code; the code table
+(:func:`default_rules`) stays the default.
 """
 
 import math
 from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
-__all__ = ["Rule", "RuleEngine", "default_rules", "detect_desync",
-           "detect_straggler", "detect_quarantine", "detect_cohort_shrink"]
+__all__ = ["Rule", "RuleEngine", "default_rules", "load_rules",
+           "DETECTORS", "detect_desync", "detect_straggler",
+           "detect_quarantine", "detect_cohort_shrink"]
 
 
 class Rule(NamedTuple):
@@ -134,6 +140,110 @@ def default_rules() -> Tuple[Rule, ...]:
         Rule("cohort-shrink-relaunch", detect_cohort_shrink,
              "elastic_relaunch", min_hits=2, debounce_s=120.0, budget=2),
     )
+
+
+#: detector names usable from a ``rules.toml`` rule table
+DETECTORS: Dict[str, Callable[[Dict], Optional[Dict]]] = {
+    "desync": detect_desync,
+    "straggler": detect_straggler,
+    "quarantine": detect_quarantine,
+    "cohort_shrink": detect_cohort_shrink,
+}
+
+#: the Rule fields a ``rules.toml`` table may set
+_RULE_KEYS = {"name", "detector", "action", "min_hits", "debounce_s",
+              "budget"}
+
+
+def _toml_scalar(raw: str, path: str, lineno: int):
+    """One TOML scalar: quoted string, int, or float."""
+    if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "\"'":
+        return raw[1:-1]
+    for conv in (int, float):
+        try:
+            return conv(raw)
+        except ValueError:
+            pass
+    raise ValueError(
+        f"{path}:{lineno}: unsupported TOML value {raw!r} (the rule-table "
+        "subset takes quoted strings, ints, and floats)")
+
+
+def load_rules(path: str) -> Tuple[Rule, ...]:
+    """Rule table from a ``rules.toml`` file — ``[[rule]]`` array-of-
+    tables, one per row, e.g.::
+
+        [[rule]]
+        name = "straggler-adapt"
+        detector = "straggler"     # a DETECTORS name
+        action = "adapt"           # a registry.CONTROL_ACTIONS name
+        min_hits = 3
+        debounce_s = 120.0
+        budget = 1
+
+    Validated loudly: unknown detectors, actions, or keys raise — a
+    typo'd table silently reverting to defaults would make the operator's
+    intent a no-op. (Hand-rolled subset parser — ``[[rule]]`` headers and
+    scalar ``key = value`` lines — because the pinned Python predates
+    ``tomllib`` and the repo vendors no TOML library.)"""
+    from dgc_tpu.telemetry import registry
+    tables: list = []
+    current: Optional[Dict] = None
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[rule]]":
+                current = {}
+                tables.append(current)
+                continue
+            if line.startswith("["):
+                raise ValueError(
+                    f"{path}:{lineno}: only [[rule]] tables are "
+                    f"supported, got {line!r}")
+            if current is None:
+                raise ValueError(
+                    f"{path}:{lineno}: key outside a [[rule]] table")
+            key, sep, raw = (p.strip() for p in line.partition("="))
+            if not sep or not key:
+                raise ValueError(
+                    f"{path}:{lineno}: expected key = value, got {line!r}")
+            if raw[:1] not in "\"'" and "#" in raw:
+                raw = raw.split("#", 1)[0].strip()
+            current[key] = _toml_scalar(raw, path, lineno)
+    if not tables:
+        raise ValueError(f"{path}: no [[rule]] tables")
+    rules = []
+    for i, t in enumerate(tables, 1):
+        missing = [k for k in ("name", "detector", "action") if k not in t]
+        if missing:
+            raise ValueError(f"{path}: rule #{i} missing keys {missing}")
+        unknown = sorted(set(t) - _RULE_KEYS)
+        if unknown:
+            raise ValueError(
+                f"{path}: rule {t['name']!r} has unknown keys {unknown} "
+                f"(known: {sorted(_RULE_KEYS)})")
+        det = t["detector"]
+        if det not in DETECTORS:
+            raise ValueError(
+                f"{path}: rule {t['name']!r}: unknown detector {det!r} "
+                f"(known: {sorted(DETECTORS)})")
+        if t["action"] not in registry.control_action_names():
+            raise ValueError(
+                f"{path}: rule {t['name']!r}: unknown action "
+                f"{t['action']!r} "
+                f"(known: {list(registry.control_action_names())})")
+        rules.append(Rule(
+            name=str(t["name"]), detect=DETECTORS[det],
+            action=str(t["action"]),
+            min_hits=int(t.get("min_hits", 2)),
+            debounce_s=float(t.get("debounce_s", 60.0)),
+            budget=int(t.get("budget", 2))))
+    names = [r.name for r in rules]
+    if len(set(names)) != len(names):
+        raise ValueError(f"{path}: duplicate rule names in {names}")
+    return tuple(rules)
 
 
 class RuleEngine:

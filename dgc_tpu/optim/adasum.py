@@ -166,10 +166,15 @@ class AdasumDistributedOptimizer(DistributedOptimizer):
 
     def update_flat(self, flat_grads, opt_state, flat_params, mem_state,
                     key, engine, telemetry: bool = False,
-                    health_out=None) -> Tuple[jax.Array, object, dict]:
+                    health_out=None,
+                    send_frac=None) -> Tuple[jax.Array, object, dict]:
         if telemetry:
             raise NotImplementedError(
                 "telemetry taps are not wired through the Adasum flat path")
+        if send_frac is not None:
+            raise NotImplementedError(
+                "straggler-adaptive send fractions are not wired through "
+                "the Adasum flat path")
         # local step FIRST (reference optimizer.py:267-275: the wrapped
         # optimizer advances on local gradients, producing the delta)
         updates, opt_state = self.optimizer.update(flat_grads, opt_state,

@@ -135,24 +135,28 @@ class DistributedOptimizer:
 
     def update_flat(self, flat_grads, opt_state, flat_params, mem_state,
                     key, engine, telemetry: bool = False,
-                    health_out: Optional[Dict] = None):
+                    health_out: Optional[Dict] = None,
+                    send_frac=None):
         """Flat-path analogue of :meth:`update`: fused exchange over the [P]
         buffer, then the wrapped optimizer on the same buffer.
 
         ``telemetry=True`` returns a fourth element — the engine's per-step
         stat pytree (``dgc_tpu.telemetry``); the default traces nothing
         extra. ``health_out`` forwards to the engine's exchange (payload-
-        checksum mismatch counter, see ``resilience.integrity``)."""
+        checksum mismatch counter, see ``resilience.integrity``);
+        ``send_frac`` forwards this worker's adaptive send fraction
+        (``resilience.adaptive``; None is Python-static off)."""
         if telemetry:
             exchanged, mem_state, tstats = engine.exchange(
                 flat_grads, mem_state, key, self.axis_name, self.num_nodes,
                 local_axis=self.local_axis_name, local_size=self.local_size,
-                telemetry=True, health_out=health_out)
+                telemetry=True, health_out=health_out,
+                send_frac=send_frac)
         else:
             exchanged, mem_state = engine.exchange(
                 flat_grads, mem_state, key, self.axis_name, self.num_nodes,
                 local_axis=self.local_axis_name, local_size=self.local_size,
-                health_out=health_out)
+                health_out=health_out, send_frac=send_frac)
         updates, opt_state = self.optimizer.update(exchanged, opt_state,
                                                    flat_params)
         if telemetry:

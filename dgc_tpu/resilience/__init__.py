@@ -21,6 +21,11 @@ pairs every guard with a deterministic injector that triggers it in tests:
   per-worker ``[world]`` state with exact gradient-mass conservation
   (``CheckpointManager.restore(elastic=True)``; ``scripts/supervise.py``
   drives the relaunch loop).
+* :mod:`adaptive` — straggler-adaptive exchange: an in-graph policy on
+  the fleet ``w_clock`` lanes degrades a lagging worker's send fraction
+  (down to a near-empty partial exchange past the deadline tier); the
+  withheld mass stays in the error-feedback residual. Off compiles away
+  byte-identically; on adds zero collectives (both contract-pinned).
 """
 
 from dgc_tpu.resilience.guard import GuardConfig, init_state

@@ -1,0 +1,99 @@
+"""Straggler-adaptive exchange policy (docs/RESILIENCE.md §Adaptive
+exchange).
+
+Closes the PR-8 loop into the hot path: fleet observability already
+*detects* the straggler in-graph (argmax over the gathered ``w_clock``
+lane), and DGC's error feedback makes under-sending safe — any gradient
+mass a worker withholds stays in its local velocity accumulator and
+re-enters a later exchange. This module is the policy between the two:
+a pure function from the gathered ``[W]`` prep-time column to a per-
+worker **effective send fraction** in ``[min_frac, 1]``.
+
+Design constraints (all contract-pinned in ``analysis/suite.py``):
+
+* **zero extra collectives** — the policy reads the ``w_clock`` column
+  the PR-8 packed all_gather already carries; the verdict is a pure
+  function of replicated values, so every worker computes the same
+  ``[W]`` fraction vector with no new exchange;
+* **zero recompiles / static shapes** — the fraction only *masks* the
+  tail of the fixed max-k payload to the structural ``(0.0, sentinel)``
+  pad the engine already tolerates (flat.py ``send_frac=``); wire
+  shapes never change;
+* **mass conservation** — masked slots are dropped from the transmit
+  record (``sent_bits``), so the next compensate keeps their mass in
+  the velocity buffer: residual + transmitted mass is conserved per
+  bucket (pinned vs a NumPy oracle in tests/test_adaptive.py);
+* **memoryless** — the fraction is recomputed from scratch every step,
+  so a transient straggler releases as soon as its clock recovers and
+  the policy state is deliberately NOT checkpointed
+  (training/checkpoint.py strips it on save and re-seeds on restore —
+  an elastic W-change resume can never hit a shape mismatch).
+
+Two degradation tiers:
+
+1. **ramp** — once the cohort gap exceeds ``engage_gap_ms``, a worker
+   lagging the cohort median by ``lag`` ms sends
+   ``clip(1 - (1 - min_frac) * lag / ramp_ms, min_frac, 1)`` of its
+   per-bucket quota (the slowest worker degrades first and most);
+2. **partial exchange** — a worker whose prep interval exceeds
+   ``deadline_factor x median`` contributes a near-empty payload
+   (``partial_frac``) for that step; error feedback absorbs the skipped
+   contribution, the same algebra the elastic merge/split pins.
+"""
+
+from typing import NamedTuple
+
+__all__ = ["AdaptiveConfig", "init_state", "update_policy"]
+
+
+class AdaptiveConfig(NamedTuple):
+    """Static policy knobs (Python-side; baked into the traced step)."""
+
+    #: cohort max-min prep gap (ms) below which the policy stays fully
+    #: disengaged (every worker sends its whole quota)
+    engage_gap_ms: float = 100.0
+    #: floor of the ramp tier: even the worst straggler keeps sending
+    #: this fraction of its quota (the partial tier may go lower)
+    min_frac: float = 0.25
+    #: lag (ms past the cohort median) over which the fraction ramps
+    #: from 1.0 down to min_frac
+    ramp_ms: float = 500.0
+    #: partial-exchange deadline: a worker slower than this multiple of
+    #: the cohort median contributes a near-empty payload this step
+    deadline_factor: float = 4.0
+    #: the near-empty payload's fraction (>0 keeps at least the very
+    #: top of each bucket flowing so the cohort never fully decouples)
+    partial_frac: float = 0.02
+    #: median floor (ms) for the deadline test — avoids a divide-style
+    #: blowup on the warmup steps where every stamp is ~0
+    floor_ms: float = 1.0
+
+
+def init_state(world):
+    """Fresh policy state: every worker at full send fraction.
+
+    Lives in ``TrainState.adaptive`` (replicated) purely to carry the
+    step-N verdict to step N+1 inside the donated state — it is NOT
+    checkpointed (see module docstring)."""
+    import jax.numpy as jnp
+
+    return {"w_frac": jnp.ones((world,), jnp.float32)}
+
+
+def update_policy(cfg: AdaptiveConfig, w_clock):
+    """Next step's per-worker send fractions from this step's gathered
+    ``[W]`` prep-time column. Traced, replicated, memoryless."""
+    import jax.numpy as jnp
+
+    w_clock = w_clock.astype(jnp.float32)
+    med = jnp.median(w_clock)
+    gap = jnp.max(w_clock) - jnp.min(w_clock)
+    lag = w_clock - med
+    frac = jnp.clip(1.0 - (1.0 - cfg.min_frac) * (lag / cfg.ramp_ms),
+                    cfg.min_frac, 1.0)
+    # partial-exchange tier: past the deadline the worker contributes a
+    # near-empty payload; error feedback keeps the withheld mass local
+    partial = w_clock > cfg.deadline_factor * jnp.maximum(med, cfg.floor_ms)
+    frac = jnp.where(partial, jnp.float32(cfg.partial_frac), frac)
+    engaged = gap > cfg.engage_gap_ms
+    return jnp.where(engaged, frac, jnp.ones_like(frac))

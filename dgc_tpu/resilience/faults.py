@@ -20,10 +20,15 @@ Comma-separated tokens, each ``kind[@step][:key=val]*``:
   preemption drill for the kill-and-resume multiprocess test).
 * ``init_fail@N`` — the first N ``jax.distributed.initialize`` attempts
   raise (exercises the bounded retry in ``parallel.multihost``).
-* ``slow[:ms=M]`` — host-side ``sleep(M ms)`` before every step dispatch
-  on the armed process (set the env on ONE worker to make it the
+* ``slow[:ms=M][@K-L]`` — host-side ``sleep(M ms)`` before every step
+  dispatch on the armed process (set the env on ONE worker to make it the
   deterministic straggler the fleet taps must name — the sleep stretches
   that process's dispatch interval, never touching the traced program).
+  An optional step window ``@K-L`` (inclusive; ``@K`` = from K onward,
+  accepted on the head ``slow@K-L:ms=M`` or trailing the param
+  ``slow:ms=M@K-L``) arms the sleep only for steps K..L — the transient-
+  straggler drill: the adaptive policy must engage inside the window and
+  release after it.
 
 With ``DGC_FAULTS`` unset every hook is an identity at trace time: zero
 ops, zero HLO difference (the guards-off compile-away contract runs with
@@ -49,13 +54,15 @@ class FaultPlan(NamedTuple):
     bitflip: Optional[Dict[str, int]] = None
     badidx: Optional[Dict[str, int]] = None
     slow_ms: Optional[int] = None
+    #: inclusive (first, last) step window for ``slow``; None = every step
+    slow_window: Optional[tuple] = None
 
 
 def plan(spec: Optional[str] = None) -> FaultPlan:
     """Parse the fault plan from ``spec`` or the ``DGC_FAULTS`` env var."""
     if spec is None:
         spec = os.environ.get(ENV, "")
-    nan_step = kill_step = slow_ms = None
+    nan_step = kill_step = slow_ms = slow_window = None
     init_failures = 0
     bitflip = badidx = None
     for tok in filter(None, (t.strip() for t in spec.split(","))):
@@ -64,6 +71,10 @@ def plan(spec: Optional[str] = None) -> FaultPlan:
         params = {}
         for p in parts[1:]:
             k, _, v = p.partition("=")
+            # a step window may trail the last param (``slow:ms=M@K-L``)
+            v, _, vat = v.partition("@")
+            if vat:
+                at = vat
             params[k] = int(v)
         if head == "nan":
             nan_step = int(at)
@@ -79,10 +90,13 @@ def plan(spec: Optional[str] = None) -> FaultPlan:
                       "set": params.get("set", -1)}
         elif head == "slow":
             slow_ms = params.get("ms", 100)
+            if at:
+                lo, _, hi = at.partition("-")
+                slow_window = (int(lo), int(hi) if hi else None)
         else:
             raise ValueError(f"unknown fault token {tok!r} in {ENV}")
     return FaultPlan(nan_step, kill_step, init_failures, bitflip, badidx,
-                     slow_ms)
+                     slow_ms, slow_window)
 
 
 def armed() -> bool:
@@ -158,14 +172,25 @@ def maybe_kill(step: int) -> None:
         os.kill(os.getpid(), signal.SIGTERM)
 
 
-def maybe_slow() -> None:
+def maybe_slow(step: Optional[int] = None) -> None:
     """Host-side sleep before a step dispatch on the armed process (the
     deterministic straggler drill: identical traced program everywhere;
-    only THIS process's dispatch interval stretches)."""
+    only THIS process's dispatch interval stretches).
+
+    ``step`` gates the windowed schedule (``slow@K-L``): the sleep fires
+    only for steps K..L inclusive (``@K`` = from K onward). A windowed
+    plan with no ``step`` supplied never fires — a caller that cannot
+    say where it is in the schedule must not straggle out of window."""
     p = plan()
-    if p.slow_ms is not None:
-        import time
-        time.sleep(p.slow_ms / 1000.0)
+    if p.slow_ms is None:
+        return
+    if p.slow_window is not None:
+        lo, hi = p.slow_window
+        if step is None or int(step) < lo or (hi is not None
+                                              and int(step) > hi):
+            return
+    import time
+    time.sleep(p.slow_ms / 1000.0)
 
 
 def should_fail_init(attempt: int) -> bool:

@@ -58,8 +58,14 @@ __all__ = [
     "detect_desync", "straggler_table", "fleet_summary",
 ]
 
-#: fleet lanes appended to the packed telemetry vector, in order
-_FLEET_LANES = ("w_clock", "w_grad_norm", "w_residual_mass", "w_sent_ratio")
+#: fleet lanes appended to the packed telemetry vector, in order; the
+#: first four are the dispersion lanes the worker_skew rollup reads —
+#: w_eff_ratio (the adaptive policy's effective send fraction,
+#: resilience/adaptive.py) is excluded from the skew: an ENGAGED policy
+#: is doing its job, not desyncing the cohort
+_FLEET_LANES = ("w_clock", "w_grad_norm", "w_residual_mass", "w_sent_ratio",
+                "w_eff_ratio")
+_SKEW_LANES = ("w_clock", "w_grad_norm", "w_residual_mass", "w_sent_ratio")
 
 #: relative-dispersion floor: cohort spreads below this never alert
 _EPS = 1e-12
@@ -70,14 +76,18 @@ _EPS = 1e-12
 # --------------------------------------------------------------------- #
 
 def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
-                 total_elems: int) -> Tuple[Dict, Dict]:
+                 total_elems: int, eff_ratio=None) -> Tuple[Dict, Dict]:
     """One packed all_gather -> ``(telemetry_means, fleet_stats)``.
 
     ``stats`` — the per-worker STEP_METRICS pytree (taps.assemble_step_
     stats output). ``clock`` — this worker's shard of the [world] f32
     prep-interval input (see :func:`make_clock`). ``total_elems`` —
     the engine's total model element count (Python int, static), the
-    sent-ratio denominator.
+    sent-ratio denominator. ``eff_ratio`` — this worker's adaptive
+    effective send fraction (a traced f32 scalar,
+    resilience/adaptive.py); None (adaptive off) stamps a constant 1.0
+    lane, so the packed vector's shape — and the program's collective
+    count — never depends on the mode.
 
     Replaces ``taps.pmean_stats``: the telemetry means are computed
     locally from the gathered matrix (identical on every worker, so the
@@ -97,10 +107,13 @@ def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
     denom = max(int(total_elems), 1)  # dgclint: ok[host-sync] — static engine geometry (Python int), not a tracer
     sent_ratio = (stats["payload_elems"].astype(jnp.float32)
                   / jnp.float32(denom))
+    eff = (jnp.ones((), jnp.float32) if eff_ratio is None
+           else jnp.asarray(eff_ratio, jnp.float32).reshape(()))
     fvec = jnp.stack([local_clock,
                       stats["grad_norm"].astype(jnp.float32),
                       stats["residual_mass"].astype(jnp.float32),
-                      sent_ratio])
+                      sent_ratio,
+                      eff])
 
     packed = jnp.concatenate(
         [l.reshape(-1).astype(jnp.float32) for l in leaves] + [fvec])
@@ -109,7 +122,7 @@ def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
     # nidx*local_size+lidx worker numbering
     mat = jax.lax.all_gather(packed, axes if len(axes) > 1 else axes[0],
                              axis=0, tiled=False)
-    mat = mat.reshape((-1, packed.shape[0]))        # [W, total + 4]
+    mat = mat.reshape((-1, packed.shape[0]))        # [W, total + 5]
 
     mean = jnp.mean(mat[:, :total], axis=0)
     out, off = [], 0
@@ -122,13 +135,19 @@ def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
             for i, name in enumerate(_FLEET_LANES)}   # each [W]
     w_clock = cols["w_clock"]
     skews = []
-    for col in cols.values():
+    for name in _SKEW_LANES:
+        col = cols[name]
         spread = jnp.max(col) - jnp.min(col)
         skews.append(spread / jnp.maximum(jnp.abs(jnp.mean(col)), _EPS))
     fleet = dict(cols)
     fleet["straggler"] = jnp.argmax(w_clock).astype(jnp.float32)
     fleet["straggler_gap"] = jnp.max(w_clock) - jnp.min(w_clock)
     fleet["worker_skew"] = jnp.max(jnp.stack(skews))
+    # any worker below full send fraction => the adaptive policy is
+    # engaged somewhere in the cohort (1.0/0.0 gauge; off-mode lanes are
+    # constant 1.0, so this reads 0.0 there)
+    fleet["adaptive_engaged"] = (
+        jnp.min(cols["w_eff_ratio"]) < 0.999).astype(jnp.float32)
     registry.validate_fleet_stats(fleet)
     return telem, {k: jnp.asarray(v, jnp.float32) for k, v in fleet.items()}
 

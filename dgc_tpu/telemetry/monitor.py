@@ -75,6 +75,9 @@ _WORKER_GAUGES = {
                         "per-worker L1 mass of the error-feedback residual"),
     "w_sent_ratio": ("dgc_worker_sent_ratio",
                      "per-worker transmitted / total model elements"),
+    "w_eff_ratio": ("dgc_worker_eff_ratio",
+                    "per-worker effective send fraction from the "
+                    "straggler-adaptive policy (1.0 = undegraded)"),
 }
 
 #: OpenMetrics names for scalar record columns (latest step's value)
@@ -89,6 +92,9 @@ _SCALAR_GAUGES = {
                       "max-min prep interval across workers (ms)"),
     "worker_skew": ("dgc_worker_skew",
                     "max relative cross-worker dispersion"),
+    "adaptive_engaged": ("dgc_adaptive_engaged",
+                         "1 when the straggler-adaptive policy degraded "
+                         "at least one worker this step"),
     "skipped_steps": ("dgc_guard_skipped_steps",
                       "cumulative guard-skipped updates"),
     "nonfinite_rate": ("dgc_guard_nonfinite_rate",
@@ -412,6 +418,16 @@ def render_status(snap: Dict) -> str:
     else:
         lines.append("   (no fleet clock column — run without "
                      "configs/fleet.py?)")
+
+    if last.get("adaptive_engaged"):
+        eff = last.get("w_eff_ratio")
+        degraded = ""
+        if isinstance(eff, list) and eff:
+            degraded = "  " + "  ".join(
+                f"w{i}={float(v):.2f}" for i, v in enumerate(eff)
+                if isinstance(v, (int, float)) and v < 0.999)
+        lines.append("   ADAPTIVE: straggler send fraction degraded"
+                     + degraded)
 
     n_alerts = summary.get("desync_alerts", 0)
     if n_alerts:

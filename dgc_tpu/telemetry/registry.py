@@ -108,6 +108,10 @@ FLEET_METRICS: Tuple[MetricSpec, ...] = (
     MetricSpec("w_sent_ratio", "per_worker",
                "per-worker transmitted elements / total model elements "
                "(the sent-bits ratio)"),
+    MetricSpec("w_eff_ratio", "per_worker",
+               "per-worker effective send fraction from the straggler-"
+               "adaptive policy (resilience.adaptive) — 1.0 when the "
+               "policy is off or disengaged, < 1 for a degraded worker"),
     MetricSpec("straggler", "scalar",
                "argmax worker index of w_clock this step (the worker the "
                "cohort waited on)"),
@@ -117,6 +121,10 @@ FLEET_METRICS: Tuple[MetricSpec, ...] = (
     MetricSpec("worker_skew", "scalar",
                "max over the monitored dimensions of the relative cohort "
                "dispersion (max - min) / max(|mean|, eps)", better="lower"),
+    MetricSpec("adaptive_engaged", "scalar",
+               "1.0 when the straggler-adaptive policy degraded at least "
+               "one worker's send fraction this step (min w_eff_ratio < "
+               "1), else 0.0", better="lower"),
 )
 
 #: remediations the control plane (dgc_tpu.control, ISSUE 12) may take on a
@@ -139,6 +147,11 @@ CONTROL_ACTIONS: Tuple[MetricSpec, ...] = (
                "stop relaunching the run but keep its artifacts (telemetry, "
                "flight.json, checkpoints) for post-mortem — the "
                "nonfinite-streak / flight-dump remediation", better="lower"),
+    MetricSpec("adapt", "action",
+               "publish DGC_ADAPTIVE=1 through the supervisor's --env-file "
+               "and restart so the relaunch runs with the straggler-"
+               "adaptive exchange engaged (resilience.adaptive) — the "
+               "persistent-straggler soft remediation", better="lower"),
 )
 
 #: run-level summary keys the regression gate compares (step time and
@@ -174,6 +187,11 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
     MetricSpec("straggler_gap", "scalar",
                "median per-step max-min dispatch-interval gap across "
                "workers, ms (bench.py fleet.straggler_gap)", better="lower"),
+    MetricSpec("straggler_stall_ms", "scalar",
+               "median per-step stall the cohort spends waiting on its "
+               "slowest worker: max(w_clock) - median(w_clock), ms "
+               "(bench.py fleet.straggler_stall_ms) — the quantity the "
+               "adaptive exchange exists to shrink", better="lower"),
 )
 
 

@@ -72,7 +72,7 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
     # fleet dispersion medians (lower is better; see registry)
     flt = obj.get("fleet")
     if isinstance(flt, dict):
-        for k in ("worker_skew", "straggler_gap"):
+        for k in ("worker_skew", "straggler_gap", "straggler_stall_ms"):
             if isinstance(flt.get(k), (int, float)):
                 out[k] = float(flt[k])
     return out

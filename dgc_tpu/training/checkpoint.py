@@ -62,6 +62,13 @@ class CheckpointManager:
         coordinator only, fenced by barriers so no process races a
         directory that is being rotated. Single-process keeps the simple
         host-materialized write."""
+        if getattr(state, "adaptive", None) is not None:
+            # the straggler-adaptive policy state is memoryless (one
+            # step's verdict, recomputed every step) and deliberately NOT
+            # checkpointed: stripping it keeps old checkpoints and elastic
+            # world-size changes restore-compatible — restore re-seeds a
+            # fresh full-send verdict from the caller's template
+            state = state.replace(adaptive=None)
         multi = jax.process_count() > 1
         coord = jax.process_index() == 0
         path = self._epoch_dir(epoch)
@@ -357,10 +364,17 @@ class CheckpointManager:
         """``_restore_state`` with the pre-resilience fallback: a
         checkpoint without the guard-counter subtree retries without it
         (the caller re-seeds fresh guard state rather than discarding an
-        otherwise-good checkpoint)."""
+        otherwise-good checkpoint). The adaptive policy field is never
+        saved (see :meth:`save`), so the restore always runs against the
+        adaptive-stripped template and the template's fresh verdict is
+        re-attached after — which also makes elastic world-size changes
+        immune to the [world]-shaped ``w_frac`` leaf."""
+        adaptive = getattr(template, "adaptive", None)
+        if adaptive is not None:
+            template = template.replace(adaptive=None)
         try:
-            return self._restore_state(path, template,
-                                       force_host=force_host)
+            state = self._restore_state(path, template,
+                                        force_host=force_host)
         except Exception:
             if getattr(template, "guards", None) is None:
                 raise
@@ -369,7 +383,9 @@ class CheckpointManager:
                                         force_host=force_host)
             print(f"[checkpoint] {path} predates the resilience guard "
                   "counters — they start fresh")
-            return state
+        if adaptive is not None:
+            state = state.replace(adaptive=adaptive)
+        return state
 
     def _restore_state(self, path: str, template: Any,
                        force_host: bool = False) -> Any:

@@ -37,6 +37,13 @@ class TrainState(struct.PyTreeNode):
     #: exactly the pre-resilience leaf structure, so old checkpoints
     #: restore unchanged and the guards-off step compiles byte-identically
     guards: Any = None
+    #: straggler-adaptive exchange policy state
+    #: (dgc_tpu.resilience.adaptive: {"w_frac": [world] f32}), replicated;
+    #: same None-is-empty doctrine as ``guards``. Deliberately NOT
+    #: checkpointed — the policy is memoryless, and stripping it keeps
+    #: old checkpoints AND elastic world-size changes restore-compatible
+    #: (training/checkpoint.py strips on save, re-seeds on restore)
+    adaptive: Any = None
 
 
 def with_leading_axis(tree: Any, world_size: int) -> Any:
@@ -83,6 +90,7 @@ def state_specs(state: TrainState, axis="data",
         memory=jax.tree.map(lambda _: P(axis), state.memory),
         batch_stats=jax.tree.map(lambda _: P(axis), state.batch_stats),
         guards=jax.tree.map(lambda _: P(), state.guards),
+        adaptive=jax.tree.map(lambda _: P(), state.adaptive),
     )
 
 

@@ -68,13 +68,18 @@ def make_flat_setup(variables, dist_opt: DistributedOptimizer,
 
 def make_flat_state(variables, dist_opt: DistributedOptimizer,
                     setup: FlatSetup, world_size: int,
-                    guards=None) -> TrainState:
+                    guards=None, adaptive=None) -> TrainState:
     """Initial flat TrainState (params/opt replicated; memory and BN stats
     per-worker with a leading [world] axis, as in ``dgc_tpu.training.state``).
 
     ``guards`` — a ``resilience.guard.GuardConfig`` to carry guard
     counters in the state (pass the SAME config to
-    :func:`build_train_step`); None keeps the pre-resilience pytree."""
+    :func:`build_train_step`); None keeps the pre-resilience pytree.
+
+    ``adaptive`` — a ``resilience.adaptive.AdaptiveConfig`` to carry the
+    straggler-adaptive send-fraction verdict in the state (again pass the
+    SAME config to :func:`build_train_step`); None keeps the field an
+    empty pytree, so the off-path state is structurally unchanged."""
     flat_params = setup.layout.flatten(variables["params"])
     flat_stats = setup.stats_layout.flatten(variables.get("batch_stats", {}))
     opt_state = dist_opt.init(flat_params)
@@ -85,13 +90,19 @@ def make_flat_state(variables, dist_opt: DistributedOptimizer,
         gstate = _guard.init_state(guards)
     else:
         gstate = None
+    if adaptive is not None:
+        from dgc_tpu.resilience import adaptive as _adaptive
+        astate = _adaptive.init_state(world_size)
+    else:
+        astate = None
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=flat_params,
         opt_state=opt_state,
         memory=with_leading_axis(setup.engine.init_memory(), world_size),
         batch_stats=with_leading_axis(flat_stats, world_size),
-        guards=gstate)
+        guards=gstate,
+        adaptive=astate)
 
 
 def _squeeze0(tree):
@@ -134,7 +145,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                      use_dropout: bool = False, donate: bool = True,
                      flat: Optional[FlatSetup] = None,
                      model_dtype=None, telemetry: bool = False,
-                     guards=None, fleet: bool = False):
+                     guards=None, fleet: bool = False, adaptive=None):
     """Build the jitted data-parallel DGC train step.
 
     Returns ``step_fn(state, images, labels, key) -> (state, metrics)`` where
@@ -196,10 +207,27 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
     costs at most ONE packed collective over the plain step and zero
     host syncs (contract-pinned). ``fleet=False`` traces none of it:
     byte-identical to the pre-fleet program.
+
+    ``adaptive`` (requires ``fleet=True``): a
+    ``resilience.adaptive.AdaptiveConfig`` enabling the straggler-
+    adaptive exchange — each worker reads last step's replicated policy
+    verdict (``state.adaptive["w_frac"][widx]``) and transmits that
+    fraction of its per-bucket quota (the tail of the fixed payload is
+    masked to the structural sentinel pad, so wire shapes never change);
+    the next verdict is recomputed in-graph from the gathered ``w_clock``
+    column the fleet taps already carry. Zero extra collectives, zero
+    recompiles, and the withheld mass stays in the error-feedback
+    residual (all contract-pinned in ``dgc_tpu.analysis.suite``). The
+    state must carry the policy field (``make_flat_state(...,
+    adaptive=cfg)``) and the fleet metrics gain a real ``w_eff_ratio``
+    column. The default None compiles it all away byte-identically.
     """
     if fleet and not telemetry:
         raise ValueError("fleet dispersion taps require telemetry=True "
                          "(they extend the telemetry lane)")
+    if adaptive is not None and not fleet:
+        raise ValueError("adaptive straggler exchange requires fleet=True "
+                         "(the policy reads the gathered w_clock lane)")
     if telemetry and flat is None:
         raise ValueError("telemetry taps require the flat engine path "
                          "(pass flat=make_flat_setup(...))")
@@ -213,6 +241,8 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             "builder — the mismatch counter travels in the guard metrics")
     if guards is not None:
         from dgc_tpu.resilience import guard as _guard
+    if adaptive is not None:
+        from dgc_tpu.resilience import adaptive as _adaptive
     loss_fn = make_loss_fn(apply_fn)
     world = dist_opt.world_size
     axes = dist_opt.data_axes      # (axis,) flat, (hosts, local) two-tier
@@ -231,22 +261,26 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
         want_health = (guards is not None
                        and getattr(engine, "checksum", False))
 
-        def do_update(grads, params, opt_state, memory, key):
+        def do_update(grads, params, opt_state, memory, key,
+                      send_frac=None):
             health = {} if want_health else None
             if telemetry:
                 upd, opt_state, memory, tstats = dist_opt.update_flat(
                     grads, opt_state, params, memory, key, engine,
-                    telemetry=True, health_out=health)
+                    telemetry=True, health_out=health,
+                    send_frac=send_frac)
                 return params + upd, opt_state, memory, tstats, health
             upd, opt_state, memory = dist_opt.update_flat(
                 grads, opt_state, params, memory, key, engine,
-                health_out=health)
+                health_out=health, send_frac=send_frac)
             return params + upd, opt_state, memory, None, health
     else:
         unpack_params = unpack_stats = pack_grads = pack_stats = (
             lambda x: x)
 
-        def do_update(grads, params, opt_state, memory, key):
+        def do_update(grads, params, opt_state, memory, key,
+                      send_frac=None):
+            del send_frac   # per-tensor path: adaptive requires flat
             upd, opt_state, memory = dist_opt.update(
                 grads, opt_state, params, memory, key)
             return (optax.apply_updates(params, upd), opt_state, memory,
@@ -306,6 +340,14 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             sparsify_key = jax.random.split(
                 jax.random.fold_in(key, world + nidx))[1]
 
+        if adaptive is not None:
+            # this worker's send fraction: LAST step's replicated policy
+            # verdict, carried in the donated state (one-step feedback —
+            # no extra collective; the verdict below refreshes it)
+            frac = state.adaptive["w_frac"][widx]
+        else:
+            frac = None
+
         mb_images = images.reshape((nbps, -1) + images.shape[1:])
         mb_labels = labels.reshape((nbps, -1))
 
@@ -356,7 +398,8 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                       else state.opt_state)
         with _trace.phase("update"):
             new_params, opt_state, memory, tstats, health = do_update(
-                grads, state.params, opt_state0, memory, sparsify_key)
+                grads, state.params, opt_state0, memory, sparsify_key,
+                send_frac=frac)
 
         if guards is not None:
             # the per-worker badness flag rides the loss all-reduce as a
@@ -378,12 +421,24 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             # costs at most one packed collective over the plain step
             from dgc_tpu.telemetry import fleet as _fleet
             metrics["telemetry"], metrics["fleet"] = _fleet.gather_stats(
-                tstats, axes, clock=clock, total_elems=layout.total)
+                tstats, axes, clock=clock, total_elems=layout.total,
+                eff_ratio=frac)
         elif telemetry:
             # per-worker stats -> replicated (mesh mean), matching the
             # loss: the collective rides the same program (no dispatch)
             from dgc_tpu.telemetry import taps
             metrics["telemetry"] = taps.pmean_stats(tstats, axes)
+
+        if adaptive is not None:
+            # next step's verdict from THIS step's gathered clock column.
+            # Pure function of replicated values -> every worker computes
+            # the identical [W] vector with no new exchange; memoryless,
+            # so no guard revert is needed (a skipped step's clock is as
+            # real a straggler signal as an applied one)
+            new_adaptive = {"w_frac": _adaptive.update_policy(
+                adaptive, metrics["fleet"]["w_clock"])}
+        else:
+            new_adaptive = state.adaptive
 
         if guards is not None:
             skip, gstate, gmetrics = _guard.apply(
@@ -411,6 +466,7 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             memory=_expand0(memory),
             batch_stats=_expand0(packed_stats),
             guards=gstate,
+            adaptive=new_adaptive,
         )
         return new_state, metrics
 

@@ -69,6 +69,17 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_control.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "CONTROL_SMOKE=ok" || { echo "CONTROL_SMOKE=FAIL"; rc=1; }
+# adaptive smoke (docs/RESILIENCE.md §Adaptive exchange): policy units,
+# the engine-level masked exchange vs the NumPy mass-conservation oracle,
+# checkpoint strip/re-seed (incl. the elastic world-change resume), the
+# windowed slow fault, and the rules.toml/adapt control-plane delivery —
+# plus the REAL 2-process drill: a windowed injected straggler whose
+# effective send fraction must drop while the healthy workers' stays at
+# full quota, then release after the window
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_adaptive.py \
+  "tests/test_multiprocess.py::test_fleet_two_process_adaptive" \
+  -q -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "ADAPTIVE_SMOKE=ok" || { echo "ADAPTIVE_SMOKE=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite — nonzero on any un-allowlisted finding
 # or broken step invariant (one sparse exchange, telemetry compiles away,

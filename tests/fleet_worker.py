@@ -12,6 +12,15 @@ The parent arms ``DGC_FAULTS=slow:ms=...`` on process 1 only, so that
 process sleeps before every dispatch: its workers' dispatch intervals
 stretch and the fleet view must name one of them the straggler. Prints one
 ``RESULT:`` JSON line per process with the in-graph straggler verdicts.
+
+With ``adaptive`` as a 6th argv (the straggler-adaptive drill,
+tests/test_multiprocess.py::test_fleet_two_process_adaptive), the step is
+built with ``resilience.adaptive.AdaptiveConfig()`` and the RESULT line
+additionally carries the per-step ``w_eff_ratio`` / ``w_sent_ratio``
+columns — the parent asserts the straggler's effective send fraction
+drops while the healthy workers' stays at 1. A windowed fault
+(``slow:ms=M@K-L``) makes it the transient-straggler drill: the policy
+must engage inside the window and release after it.
 """
 
 import json
@@ -37,6 +46,7 @@ def main():
     num_procs = int(sys.argv[2])
     coord = sys.argv[3]
     workdir = sys.argv[4]
+    adaptive_on = len(sys.argv) > 5 and sys.argv[5] == "adaptive"
 
     from dgc_tpu.parallel.multihost import (host_local_to_global,
                                             initialize_multihost)
@@ -97,11 +107,16 @@ def main():
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
     dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
                                 world_size=W)
+    acfg = None
+    if adaptive_on:
+        from dgc_tpu.resilience.adaptive import AdaptiveConfig
+        acfg = AdaptiveConfig()
     setup = make_flat_setup(v, dist)
-    state = shard_state(make_flat_state(v, dist, setup, W), mesh,
-                        dist_opt=dist)
+    state = shard_state(make_flat_state(v, dist, setup, W, adaptive=acfg),
+                        mesh, dist_opt=dist)
     step_fn = build_train_step(apply_fn, dist, mesh, donate=False,
-                               flat=setup, telemetry=True, fleet=True)
+                               flat=setup, telemetry=True, fleet=True,
+                               adaptive=acfg)
 
     run_dir = os.path.join(workdir, "fleetrun")
     sink = TelemetrySink(
@@ -124,7 +139,8 @@ def main():
     kept = []
     for i in range(STEPS):
         if faults.armed():
-            faults.maybe_slow()          # the injected straggler drill
+            faults.maybe_slow(i)         # the injected straggler drill
+                                         # (step-gated for @K-L windows)
         im, lb = batch(i)
         # w_clock lane: host PREP time only — previous dispatch RETURN to
         # this dispatch START. The dispatch call itself is excluded: it
@@ -150,6 +166,13 @@ def main():
            "stragglers": stragglers,
            "gaps": [round(g, 3) for g in gaps],
            "sink": sink.path or ""}
+    if adaptive_on:
+        out["eff"] = [[round(float(x), 4) for x in np.asarray(f["w_eff_ratio"])]
+                      for f in kept]
+        out["sent"] = [[round(float(x), 5)
+                        for x in np.asarray(f["w_sent_ratio"])]
+                       for f in kept]
+        out["engaged"] = [float(f["adaptive_engaged"]) for f in kept]
     print("RESULT:" + json.dumps(out), flush=True)
 
     from jax.experimental import multihost_utils

@@ -1,0 +1,521 @@
+"""Straggler-adaptive exchange (ISSUE 13, dgc_tpu.resilience.adaptive).
+
+Covers the policy function, the engine-level masked exchange (mass
+conservation vs a NumPy error-feedback oracle over real multi-step
+exchanges), the full fleet train step (verdict feed-forward, the
+w_eff_ratio lane, engage/release), checkpoint semantics (the policy
+state is never saved; restore re-seeds the template's fresh verdict —
+including across an elastic world-size change), the windowed ``slow``
+fault schedule, and the control-plane pieces that deliver the mode
+(``rules.toml`` loading, the ``adapt`` remediation). The 2-process
+injected-straggler drill lives in tests/test_multiprocess.py.
+"""
+
+import json
+import os
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dgc_tpu import (DGCCompressor, DGCSGDMemory, DistributedOptimizer,
+                     dgc_sgd)
+from dgc_tpu.ops import kernels
+from dgc_tpu.resilience import adaptive
+from dgc_tpu.resilience.adaptive import AdaptiveConfig
+from dgc_tpu.training import TrainState
+from dgc_tpu.training.checkpoint import CheckpointManager
+from dgc_tpu.utils.compat import shard_map
+from dgc_tpu.utils.pytree import named_flatten
+
+W = 8
+
+
+# --------------------------------------------------------------------- #
+# policy units                                                           #
+# --------------------------------------------------------------------- #
+
+def _frac(cfg, clock):
+    return np.asarray(adaptive.update_policy(
+        cfg, jnp.asarray(clock, jnp.float32)))
+
+
+@pytest.mark.fast
+def test_policy_disengaged_below_gap():
+    cfg = AdaptiveConfig()
+    # a healthy cohort (gap < engage_gap_ms) sends everything
+    np.testing.assert_array_equal(
+        _frac(cfg, [10.0] * W), np.ones(W, np.float32))
+    np.testing.assert_array_equal(
+        _frac(cfg, [10, 10, 10, 10 + cfg.engage_gap_ms * 0.9,
+                    10, 10, 10, 10]), np.ones(W, np.float32))
+
+
+@pytest.mark.fast
+def test_policy_ramp_tier():
+    cfg = AdaptiveConfig()          # engage 100, min 0.25, ramp 500
+    clock = [200.0] * 7 + [350.0]   # lag 150 past the median
+    f = _frac(cfg, clock)
+    # healthy workers keep full quota, the straggler ramps down
+    np.testing.assert_array_equal(f[:7], 1.0)
+    assert f[7] == pytest.approx(1.0 - 0.75 * 150.0 / 500.0)
+    # monotone: a worse lag degrades further, floored at min_frac
+    worse = _frac(cfg, [200.0] * 7 + [500.0])
+    assert worse[7] < f[7]
+    floored = _frac(cfg, [200.0] * 7 + [5000.0])
+    # (5000 > 4x median also trips the partial tier — pin the pure ramp
+    # floor with the deadline pushed out of reach)
+    far = AdaptiveConfig(deadline_factor=1e9)
+    assert _frac(far, [200.0] * 7 + [5000.0])[7] == pytest.approx(
+        far.min_frac)
+    assert floored[7] <= far.min_frac
+
+
+@pytest.mark.fast
+def test_policy_partial_exchange_tier():
+    cfg = AdaptiveConfig()
+    # past deadline_factor x median: near-empty payload, not the ramp
+    f = _frac(cfg, [10.0] * 7 + [200.0])    # 200 > 4 * 10
+    assert f[7] == pytest.approx(cfg.partial_frac)
+    np.testing.assert_array_equal(f[:7], 1.0)
+    # warmup guard: ~0 stamps everywhere must not trip the deadline
+    np.testing.assert_array_equal(
+        _frac(cfg, [0.0] * W), np.ones(W, np.float32))
+
+
+@pytest.mark.fast
+def test_policy_release_is_immediate():
+    cfg = AdaptiveConfig()
+    assert _frac(cfg, [10.0] * 7 + [400.0])[7] < 1.0
+    # memoryless: the very next healthy clock restores full send
+    np.testing.assert_array_equal(
+        _frac(cfg, [10.0] * W), np.ones(W, np.float32))
+    st = adaptive.init_state(W)
+    np.testing.assert_array_equal(np.asarray(st["w_frac"]), 1.0)
+
+
+# --------------------------------------------------------------------- #
+# engine: masked exchange vs the NumPy error-feedback oracle             #
+# --------------------------------------------------------------------- #
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "conv1": {"kernel": jnp.asarray(rng.randn(3, 3, 4, 8), jnp.float32)},
+        "conv2": {"kernel": jnp.asarray(rng.randn(3, 3, 8, 8), jnp.float32)},
+        "dense": {"kernel": jnp.asarray(rng.randn(32, 10), jnp.float32),
+                  "bias": jnp.asarray(rng.randn(10), jnp.float32)},
+    }
+
+
+def _engine():
+    params = _params()
+    named, _ = named_flatten(params)
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=1.0)
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    layout, engine = dist.make_flat(params)
+    return comp, layout, engine
+
+
+def _grads(layout, rng):
+    g = np.zeros((W, layout.total), np.float32)
+    for n in layout.names:
+        o, s = layout.offsets[n], layout.sizes[n]
+        g[:, o:o + s] = rng.randn(W, s)
+    return g
+
+
+def _exchange_fn(engine, mesh, with_frac):
+    def worker(fg, mem, key, frac):
+        fg = fg[0]
+        mem = jax.tree.map(lambda x: x[0], mem)
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        out, mem = engine.exchange(
+            fg, mem, key, "data", W, op="sum",
+            send_frac=frac[0] if with_frac else None)
+        return out[None], jax.tree.map(lambda x: x[None], mem)
+
+    return jax.jit(shard_map(
+        worker, mesh=mesh,
+        in_specs=(P("data"), P("data"), P(), P("data")),
+        out_specs=(P("data"), P("data")), check_vma=False))
+
+
+def _init_mem(engine):
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+        engine.init_memory())
+
+
+def test_adaptive_full_frac_is_bitwise_identity(mesh8):
+    """send_frac == 1.0 on every worker: the masked exchange is bitwise
+    the unmasked exchange — outputs AND memory (incl. the transmit
+    record), over multiple steps. The runtime complement of the
+    adaptive-off-compiles-away HLO contract."""
+    comp, layout, engine = _engine()
+    f_on = _exchange_fn(engine, mesh8, with_frac=True)
+    f_off = _exchange_fn(engine, mesh8, with_frac=False)
+    ones = jnp.ones((W,), jnp.float32)
+    mem_a, mem_b = _init_mem(engine), _init_mem(engine)
+    rng = np.random.RandomState(7)
+    for step in range(3):
+        g = jnp.asarray(_grads(layout, rng))
+        key = jax.random.PRNGKey(step)
+        out_a, mem_a = f_on(g, mem_a, key, ones)
+        out_b, mem_b = f_off(g, mem_b, key, ones)
+        np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+        for k in mem_a:
+            np.testing.assert_array_equal(np.asarray(mem_a[k]),
+                                          np.asarray(mem_b[k]))
+
+
+def test_adaptive_mass_conservation_oracle(mesh8):
+    """Real multi-step exchange with the policy engaged on two workers:
+    the wire carries exactly the transmitted slice of the velocity
+    buffer, the residual keeps the rest (deferred mask), and per-tensor
+    mass is conserved vs an independent NumPy error-feedback oracle —
+    |transmitted| + |residual| == |accumulated| to 1e-6 relative."""
+    comp, layout, engine = _engine()
+    T = engine.T
+    f = _exchange_fn(engine, mesh8, with_frac=True)
+    fracs = np.array([1, 1, 1, 0.3, 1, 1, 1, 0.65], np.float32)
+    mem = _init_mem(engine)
+    rng = np.random.RandomState(3)
+
+    # NumPy oracle of the accumulating compensate (memory.py:
+    # mmt = m*mmt + g; vec += mmt, both masked on read by the PREVIOUS
+    # step's transmit record — momentum_masking defaults True)
+    mom = comp.memory.momentum
+    v_np = np.zeros((W, T), np.float32)
+    m_np = np.zeros((W, T), np.float32)
+    keep_prev = np.ones((W, T), np.float32)
+    quotas = {n: comp.attributes[n].num_selects
+              for n in layout.names if n in comp.attributes}
+
+    sent_counts_seen = []
+    for step in range(4):
+        g = _grads(layout, rng)
+        out, mem = f(jnp.asarray(g), mem, jax.random.PRNGKey(step),
+                     jnp.asarray(fracs))
+        out0 = np.asarray(out)[0]
+        bits = np.asarray(mem["sent_bits"])
+        keep_new = np.stack([
+            np.asarray(kernels.keep_from_bits(jnp.asarray(bits[w]), T))
+            for w in range(W)])
+        sent_new = 1.0 - keep_new
+
+        # oracle recurrence (f32, mirroring the engine's elementwise ops)
+        m_np = mom * (m_np * keep_prev) + g[:, :T]
+        v_np = v_np * keep_prev + m_np
+
+        vc = np.asarray(mem["velocities_c"])          # post-step, unmasked
+        np.testing.assert_allclose(vc, v_np, rtol=1e-5, atol=1e-5)
+
+        # the wire (op="sum") is exactly the per-worker transmitted slices
+        transmitted = vc * sent_new
+        np.testing.assert_allclose(out0[:T], transmitted.sum(axis=0),
+                                   rtol=1e-5, atol=1e-5)
+
+        # residual view (memory_full materializes the pending mask)
+        full = engine.memory_full(
+            jax.tree.map(lambda x: jnp.asarray(x[0]), mem))
+        resid0 = np.asarray(full["velocities"])[:T]
+        np.testing.assert_allclose(resid0, vc[0] * keep_new[0],
+                                   rtol=1e-6, atol=1e-6)
+
+        # per-tensor mass conservation vs the oracle, every worker
+        for n, quota in quotas.items():
+            o, s = layout.offsets[n], layout.sizes[n]
+            for w in range(W):
+                raw = np.abs(v_np[w, o:o + s].astype(np.float64)).sum()
+                split = (np.abs((vc[w] * sent_new[w])[o:o + s]
+                                .astype(np.float64)).sum()
+                         + np.abs((vc[w] * keep_new[w])[o:o + s]
+                                  .astype(np.float64)).sum())
+                assert abs(split - raw) <= 1e-6 * max(raw, 1e-12), \
+                    (n, w, step)
+
+        # degraded workers transmit a visibly smaller payload, capped by
+        # ceil(quota * frac) per row; healthy workers keep theirs
+        sent_counts = sent_new.sum(axis=1)
+        cap3 = sum(int(np.ceil(q * 0.3)) for q in quotas.values())
+        assert 0 < sent_counts[3] <= cap3
+        assert sent_counts[3] < sent_counts[0]
+        assert sent_counts[4] == sent_counts[0]
+        sent_counts_seen.append(sent_counts)
+        keep_prev = keep_new
+
+    # the policy engaged on every step (not a warmup accident)
+    assert all(s[3] < s[0] for s in sent_counts_seen)
+
+
+# --------------------------------------------------------------------- #
+# full train step: verdict feed-forward + the w_eff_ratio lane           #
+# --------------------------------------------------------------------- #
+
+def test_step_adaptive_engages_and_releases(mesh8):
+    """The fleet step with adaptive on: step N's gathered clock sets
+    step N+1's send fraction (one-step feedback through the donated
+    state), the fleet metrics grow a real w_eff_ratio column +
+    adaptive_engaged scalar, and a recovered clock releases the worker
+    back to full send."""
+    from dgc_tpu.analysis.suite import build_fixture
+
+    cfg = AdaptiveConfig()
+    state, step, _, (images, labels, key) = build_fixture(
+        mesh8, donate=False, telemetry=True, fleet=True, adaptive=cfg)
+    sh = NamedSharding(mesh8, P(tuple(mesh8.axis_names)))
+
+    def clock(vals):
+        return jax.device_put(np.asarray(vals, np.float32), sh)
+
+    # step 1: fresh verdict (full send), worker 6 straggles 150ms past
+    # the 200ms cohort median — ramp tier, below the partial deadline
+    skewed = clock([200.0] * 6 + [350.0, 200.0])
+    state, metrics = step(state, images, labels, key, skewed)
+    flt = metrics["fleet"]
+    np.testing.assert_allclose(np.asarray(flt["w_eff_ratio"]), 1.0)
+    assert float(flt["adaptive_engaged"]) == 0.0
+    want = 1.0 - (1.0 - cfg.min_frac) * 150.0 / cfg.ramp_ms
+    frac = np.asarray(state.adaptive["w_frac"])
+    assert frac[6] == pytest.approx(want, rel=1e-5)
+    np.testing.assert_allclose(np.delete(frac, 6), 1.0)
+
+    # step 2: the degraded fraction reaches the wire AND the telemetry
+    state, metrics = step(state, images, labels, key, skewed)
+    eff = np.asarray(metrics["fleet"]["w_eff_ratio"])
+    assert eff[6] == pytest.approx(want, rel=1e-5)
+    np.testing.assert_allclose(np.delete(eff, 6), 1.0)
+    assert float(metrics["fleet"]["adaptive_engaged"]) == 1.0
+
+    # step 3 with a recovered clock: immediate release (memoryless)
+    state, _ = step(state, images, labels, key, clock([200.0] * 8))
+    np.testing.assert_allclose(np.asarray(state.adaptive["w_frac"]), 1.0)
+
+
+# --------------------------------------------------------------------- #
+# checkpoint: the policy state is never saved, always re-seeded          #
+# --------------------------------------------------------------------- #
+
+def _ckpt_state(value, adaptive_state=None):
+    rng = np.random.RandomState(11)
+    return TrainState(
+        step=jnp.asarray(int(value), jnp.int32),
+        params={"w": jnp.full((4,), float(value))},
+        opt_state=(jnp.zeros(()),),
+        memory={"momentums_c": jnp.asarray(rng.randn(8), jnp.float32),
+                "velocities_c": jnp.asarray(rng.randn(8), jnp.float32),
+                "sent_bits": jnp.asarray(rng.randint(0, 2 ** 10, 128),
+                                         jnp.int32)},
+        batch_stats={},
+        adaptive=adaptive_state)
+
+
+def test_checkpoint_strips_and_reseeds_adaptive(tmp_path):
+    """An emergency save taken WHILE the policy is engaged: the
+    compressor memory (incl. the packed transmit record — the conserved
+    mass) restores bitwise, the degraded verdict is NOT persisted, and
+    restore re-seeds the template's fresh full-send verdict."""
+    engaged = {"w_frac": jnp.asarray([1.0, 0.3], jnp.float32)}
+    saved = _ckpt_state(5.0, adaptive_state=engaged)
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    mgr.save(0, saved, {"m": 1.0})
+
+    template = _ckpt_state(0.0, adaptive_state=adaptive.init_state(2))
+    state, epoch, _ = mgr.restore(template)
+    assert epoch == 0
+    for k in ("momentums_c", "velocities_c", "sent_bits"):
+        np.testing.assert_array_equal(np.asarray(state.memory[k]),
+                                      np.asarray(saved.memory[k]))
+    # the restored verdict is the template's fresh one, not the saved 0.3
+    np.testing.assert_array_equal(np.asarray(state.adaptive["w_frac"]),
+                                  [1.0, 1.0])
+
+    # an adaptive-off template restores the same checkpoint unchanged
+    off = mgr.restore(_ckpt_state(0.0))[0]
+    assert off.adaptive is None
+    np.testing.assert_array_equal(np.asarray(off.params["w"]), 5.0)
+
+
+def test_checkpoint_adaptive_elastic_world_change(tmp_path):
+    """Save at W=2 with the policy engaged, resume at W=1: the [world]-
+    shaped w_frac leaf must never enter the restore (it is stripped on
+    save and re-attached from the template), so the world-size change
+    cannot shape-mismatch."""
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    mgr.save(0, _ckpt_state(
+        2.0, adaptive_state={"w_frac": jnp.asarray([0.25, 1.0])}), {})
+    template = _ckpt_state(0.0, adaptive_state=adaptive.init_state(1))
+    state, _, _ = mgr.restore(template)
+    assert np.asarray(state.adaptive["w_frac"]).shape == (1,)
+    np.testing.assert_array_equal(np.asarray(state.adaptive["w_frac"]),
+                                  [1.0])
+    np.testing.assert_array_equal(np.asarray(state.params["w"]), 2.0)
+
+
+# --------------------------------------------------------------------- #
+# windowed slow fault (the transient-straggler drill's schedule)         #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_faults_slow_window_parsing():
+    from dgc_tpu.resilience import faults
+    p = faults.plan("slow:ms=40@10-20")
+    assert p.slow_ms == 40 and p.slow_window == (10, 20)
+    assert faults.plan("slow@7-9:ms=25").slow_window == (7, 9)
+    assert faults.plan("slow@15").slow_window == (15, None)
+    assert faults.plan("slow:ms=40").slow_window is None
+    assert faults.plan("slow:ms=40").slow_ms == 40
+
+
+@pytest.mark.fast
+def test_faults_slow_window_gating(monkeypatch):
+    import time
+
+    from dgc_tpu.resilience import faults
+    monkeypatch.setenv(faults.ENV, "slow:ms=30@5-6")
+
+    def took(step):
+        t0 = time.perf_counter()
+        faults.maybe_slow(step)
+        return time.perf_counter() - t0
+
+    assert took(4) < 0.02           # before the window
+    assert took(5) >= 0.025         # inside
+    assert took(6) >= 0.025         # inclusive upper bound
+    assert took(7) < 0.02           # after
+    # a windowed plan with no step supplied must never fire
+    assert took(None) < 0.02
+
+    # open-ended @K: from K onward
+    monkeypatch.setenv(faults.ENV, "slow:ms=30@5")
+    assert took(4) < 0.02 and took(50) >= 0.025
+    # un-windowed plans keep the old any-step behavior (byte-compatible)
+    monkeypatch.setenv(faults.ENV, "slow:ms=30")
+    assert took(None) >= 0.025
+
+
+# --------------------------------------------------------------------- #
+# control plane: rules.toml + the adapt remediation                      #
+# --------------------------------------------------------------------- #
+
+RULES_TOML = """\
+# operator-tuned remediation table
+[[rule]]
+name = "straggler-adapt"
+detector = "straggler"
+action = "adapt"
+min_hits = 3
+debounce_s = 120.0   # let the relaunch settle
+budget = 1
+
+[[rule]]
+name = "desync-restart"
+detector = "desync"
+action = "restart"
+"""
+
+
+@pytest.mark.fast
+def test_load_rules_toml(tmp_path):
+    from dgc_tpu.control import rules as rules_mod
+    path = tmp_path / "rules.toml"
+    path.write_text(RULES_TOML)
+    rules = rules_mod.load_rules(str(path))
+    assert [r.name for r in rules] == ["straggler-adapt", "desync-restart"]
+    r0 = rules[0]
+    assert r0.action == "adapt" and r0.min_hits == 3
+    assert r0.debounce_s == 120.0 and r0.budget == 1
+    assert r0.detect is rules_mod.detect_straggler
+    # unset keys take the Rule defaults
+    assert rules[1].min_hits == 2 and rules[1].budget == 2
+
+
+@pytest.mark.fast
+def test_load_rules_validates_loudly(tmp_path):
+    from dgc_tpu.control.rules import load_rules
+
+    def write(text):
+        p = tmp_path / "r.toml"
+        p.write_text(text)
+        return str(p)
+
+    with pytest.raises(ValueError, match="unknown detector"):
+        load_rules(write('[[rule]]\nname = "x"\n'
+                         'detector = "nope"\naction = "adapt"\n'))
+    with pytest.raises(ValueError, match="unknown action"):
+        load_rules(write('[[rule]]\nname = "x"\n'
+                         'detector = "straggler"\naction = "nope"\n'))
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_rules(write('[[rule]]\nname = "x"\ndetector = "straggler"\n'
+                         'action = "adapt"\ntypo_key = 1\n'))
+    with pytest.raises(ValueError, match="missing keys"):
+        load_rules(write('[[rule]]\nname = "x"\naction = "adapt"\n'))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_rules(write('[[rule]]\nname = "x"\ndetector = "straggler"\n'
+                         'action = "adapt"\n'
+                         '[[rule]]\nname = "x"\ndetector = "desync"\n'
+                         'action = "restart"\n'))
+    with pytest.raises(ValueError, match="outside"):
+        load_rules(write('name = "x"\n'))
+    with pytest.raises(ValueError, match="no \\[\\[rule\\]\\]"):
+        load_rules(write("# empty\n"))
+
+
+@pytest.mark.fast
+def test_act_adapt_publishes_env_flag(tmp_path):
+    from dgc_tpu.control import actions
+    env_file = str(tmp_path / "cohort.env")
+    restarts = []
+    sup = types.SimpleNamespace(
+        env_file=env_file,
+        request_restart=lambda reason=None: restarts.append(reason) or True)
+    result = actions.act_adapt(sup, {"kind": "straggler"})
+    assert result["published"] == {"DGC_ADAPTIVE": "1"}
+    assert result["delivered"] is True
+    assert restarts == ["straggler"]
+    assert actions.parse_env_file(env_file)["DGC_ADAPTIVE"] == "1"
+    # existing cohort keys survive the merge
+    actions.publish_env(env_file, {"JAX_NUM_PROCESSES": "2"})
+    merged = actions.parse_env_file(env_file)
+    assert merged == {"DGC_ADAPTIVE": "1", "JAX_NUM_PROCESSES": "2"}
+
+    # no env-file wired: still restarts, audit says degraded
+    sup2 = types.SimpleNamespace(
+        env_file=None, request_restart=lambda reason=None: False)
+    result2 = actions.act_adapt(sup2, {"kind": "straggler"})
+    assert result2["degraded_to"] == "restart"
+    assert result2["published"] == {}
+
+
+@pytest.mark.fast
+def test_monitor_renders_adaptive_line():
+    from dgc_tpu.telemetry import monitor
+    snap = {"run": "r", "step": 9, "num_steps": 10, "world": 4,
+            "num_hosts": 1, "summary": {},
+            "last": {"adaptive_engaged": 1.0,
+                     "w_eff_ratio": [1.0, 1.0, 0.55, 1.0]}}
+    status = monitor.render_status(snap)
+    assert "ADAPTIVE: straggler send fraction degraded" in status
+    assert "w2=0.55" in status and "w0" not in status
+    # disengaged: the line disappears
+    snap["last"] = {"adaptive_engaged": 0.0,
+                    "w_eff_ratio": [1.0, 1.0, 1.0, 1.0]}
+    assert "ADAPTIVE" not in monitor.render_status(snap)
+
+
+@pytest.mark.fast
+def test_adapt_action_registered():
+    from dgc_tpu.control.actions import ACTIONS
+    from dgc_tpu.telemetry import registry
+    assert "adapt" in ACTIONS
+    assert "adapt" in registry.control_action_names()
+    # the fleet schema carries the adaptive lanes the monitor renders
+    names = registry.fleet_stat_names()
+    assert "w_eff_ratio" in names and "adaptive_engaged" in names

@@ -325,3 +325,92 @@ def test_fleet_two_process_straggler(tmp_path):
     csummary = fleet.fleet_summary(cview)
     assert csummary["desync_alerts"] > 0
     assert csummary["desync_workers"] == [bad]
+
+
+def test_fleet_two_process_adaptive(tmp_path):
+    """Straggler-adaptive drill (docs/RESILIENCE.md §Adaptive exchange):
+    the fleet step with the adaptive policy on, across 2 real processes,
+    with a WINDOWED fault (``slow:ms=350@3-8``) armed on process 1 only.
+    The policy must engage one step after the window opens (one-step
+    verdict feedback), degrade ONLY process 1's workers — their effective
+    send fraction and actual wire sent-ratio drop while the healthy
+    workers' stay at full quota — and release to full send after the
+    window closes (memoryless policy). Verdicts are replicated: both
+    processes must report identical columns."""
+    worker = os.path.join(os.path.dirname(__file__), "fleet_worker.py")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DGC_FAULTS")}
+    logs = [open(tmp_path / f"adapt_w{i}.log", "w+") for i in range(2)]
+    procs = []
+    for i in range(2):
+        e = dict(env)
+        if i == 1:
+            e["DGC_FAULTS"] = "slow:ms=350@3-8"
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(i), "2", coord, str(tmp_path),
+             "adaptive"],
+            stdout=logs[i], stderr=subprocess.STDOUT, text=True, env=e))
+    outs = []
+    for p, lf in zip(procs, logs):
+        p.wait(timeout=1500)
+        lf.seek(0)
+        outs.append(lf.read())
+        lf.close()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"adaptive proc {i} failed:\n{out[-4000:]}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT:"):
+                r = json.loads(line[len("RESULT:"):])
+                results[r["proc"]] = r
+    assert set(results) == {0, 1}
+
+    # the verdict is a pure function of gathered (replicated) columns
+    assert results[0]["eff"] == results[1]["eff"]
+    eff = results[0]["eff"]
+    engaged = results[0]["engaged"]
+
+    # before the fault window (+1 step of verdict lag): nobody degraded
+    for step in range(0, 4):
+        assert all(x == 1.0 for x in eff[step]), (step, eff[step])
+    # engaged mid-window: process 1's workers (4-7) degraded, the healthy
+    # half untouched — steps 5..9 (the sleep stamps clocks at steps 3-8,
+    # each verdict lands one step later; skip the boundary steps)
+    mid = range(5, 9)
+    for step in mid:
+        assert engaged[step] == 1.0, (step, engaged)
+        assert all(x == 1.0 for x in eff[step][:4]), (step, eff[step])
+        assert any(x < 0.999 for x in eff[step][4:]), (step, eff[step])
+    # released after the window: memoryless policy back to full send
+    for step in range(11, len(eff)):
+        assert engaged[step] == 0.0, (step, engaged)
+        assert all(x == 1.0 for x in eff[step]), (step, eff[step])
+
+    # the degradation reached the WIRE, not just the policy output: the
+    # straggler half's actual transmitted ratio drops mid-window
+    sent = results[0]["sent"]
+    for step in mid:
+        slow = sum(sent[step][4:]) / 4
+        healthy = sum(sent[step][:4]) / 4
+        assert slow < 0.95 * healthy, (step, sent[step])
+    # outside the window both halves transmit the same quota
+    last = len(sent) - 1
+    assert abs(sum(sent[last][4:]) - sum(sent[last][:4])) <= \
+        0.05 * sum(sent[last][:4])
+
+    # merged host shards carry the new lanes end to end
+    from dgc_tpu.telemetry import fleet, monitor
+
+    view = fleet.load_view(str(tmp_path / "fleetrun"))
+    series = {step: vals
+              for step, vals in fleet.worker_series(view, "w_eff_ratio")}
+    for step in mid:
+        assert min(series[step][4:]) < 0.999
+        assert all(x == 1.0 for x in series[step][:4])
+
+    om = monitor.render_openmetrics(monitor.collect(
+        str(tmp_path / "fleetrun")))
+    assert "dgc_worker_eff_ratio" in om
+    assert "dgc_adaptive_engaged" in om

@@ -116,6 +116,16 @@ def main():
                              "the plan key changes (docs/PLANNER.md "
                              "§Autotuning); same as stacking "
                              "configs/autotune.py")
+    parser.add_argument("--adaptive", action="store_true",
+                        help="straggler-adaptive exchange: a flagged "
+                             "straggler transmits a smaller fraction of "
+                             "its per-bucket quota (withheld mass stays in "
+                             "the error-feedback residual) so the cohort "
+                             "stops paying its full lag "
+                             "(docs/RESILIENCE.md §Adaptive exchange); "
+                             "needs the fleet taps (configs/fleet.py); "
+                             "same as stacking configs/adaptive.py or "
+                             "setting DGC_ADAPTIVE=1")
     args, opts = parser.parse_known_args()
 
     if args.cpu_mesh or args.devices == "cpu":
@@ -343,6 +353,39 @@ def main():
         raise SystemExit("--autotune plans the sparse DGC wire "
                          "(configs with train.dgc = True)")
 
+    # straggler-adaptive exchange (configs/adaptive.py, --adaptive, or
+    # DGC_ADAPTIVE=1 — the control plane's `adapt` action delivers the env
+    # var through the supervisor's --env-file; docs/RESILIENCE.md
+    # §Adaptive exchange). Resolved BEFORE the state build: the policy
+    # verdict travels in TrainState.adaptive.
+    acfg = configs.train.get("adaptive", None)
+    adaptive_on = bool(args.adaptive or os.environ.get("DGC_ADAPTIVE")
+                       or (acfg and acfg.get("enabled", False)))
+    adaptive_cfg = None
+    if adaptive_on:
+        if not configs.train.dgc:
+            raise SystemExit("--adaptive degrades the sparse DGC wire "
+                             "(configs with train.dgc = True)")
+        _tc = configs.train.get("telemetry", None)
+        if not (_tc and _tc.get("enabled", False)
+                and _tc.get("fleet", False)):
+            raise SystemExit(
+                "--adaptive reads the fleet w_clock lane: stack "
+                "configs/fleet.py (train.telemetry.enabled + fleet) — "
+                "configs/adaptive.py stacks both")
+        from dgc_tpu.resilience.adaptive import AdaptiveConfig
+
+        def _ak(k, d):
+            return float(acfg.get(k, d)) if acfg else d
+        adaptive_cfg = AdaptiveConfig(
+            engage_gap_ms=_ak("engage_gap_ms", 100.0),
+            min_frac=_ak("min_frac", 0.25),
+            ramp_ms=_ak("ramp_ms", 500.0),
+            deadline_factor=_ak("deadline_factor", 4.0),
+            partial_frac=_ak("partial_frac", 0.02),
+            floor_ms=_ak("floor_ms", 1.0))
+        printr(f"[adaptive] {adaptive_cfg}")
+
     flat_setup = make_flat_setup(variables, dist)
     if autotune_on:
         from dgc_tpu.compression.autotune import Autotuner
@@ -356,7 +399,8 @@ def main():
                f"({autotuner.fabric.gbps:.3g} GB/s) -> "
                f"plan {list(flat_setup.engine.regimes)}")
     state = shard_state(make_flat_state(variables, dist, flat_setup, world,
-                                        guards=guards_cfg),
+                                        guards=guards_cfg,
+                                        adaptive=adaptive_cfg),
                         mesh, axis, dist_opt=dist)
 
     # resume from checkpoint (reference train.py:152-165); the topology
@@ -606,7 +650,8 @@ def main():
                                        model_dtype=_narrow_model_dtype(model),
                                        telemetry=telemetry_on,
                                        guards=guards_cfg,
-                                       fleet=fleet_on)
+                                       fleet=fleet_on,
+                                       adaptive=adaptive_cfg)
             if sink is not None:
                 # engine geometry changes with the warm-up ratio: record
                 # it so readers can re-anchor the per-bucket columns
@@ -677,7 +722,7 @@ def main():
                         # process's prep interval
                         from dgc_tpu.resilience import faults as _flt
                         if _flt.armed():
-                            _flt.maybe_slow()
+                            _flt.maybe_slow(gstep)
                         # w_clock lane: host PREP time — previous
                         # dispatch RETURN to this dispatch START. The
                         # dispatch call can block on the cohort
