@@ -16,15 +16,26 @@ Two layers, one CLI gate (``python -m dgc_tpu.analysis``):
   compiled-step guarantees (one sparse exchange, telemetry compiles away,
   donated buffers alias, no opt-barriers in the fused-apply epilogue).
 
+* **Layer 3 — dgcver dataflow verifier** (:mod:`~dgc_tpu.analysis.jaxpr`,
+  :mod:`~dgc_tpu.analysis.verify`): typed jaxpr traversal (provenance,
+  closed-jaxpr recursion, collective extraction WITH axis names) and four
+  static taint passes over every pinned engine config — collective-axis
+  audit against an AxisPolicy, f32 dtype-flow, donation/liveness with a
+  ``runs/analysis_report.json`` regress feed, and the error-feedback
+  conservation proof (``--verify``; ``--fast`` skips compiles).
+
 Audited exceptions live in ``analysis/allowlist.toml`` (one-line
-justification each); see docs/ANALYSIS.md for the rule catalog and how to
-add a rule or contract.
+justification each) or inline ``# dgclint: ok[rule]`` /
+``# dgcver: ok[pass]`` markers; see docs/ANALYSIS.md for the catalogs and
+how to add a rule, contract, or pass.
 """
 
-from dgc_tpu.analysis.rules import RULES, Allowlist, Finding  # noqa: F401
+from dgc_tpu.analysis.rules import (RULES, VERIFY_PASSES,  # noqa: F401
+                                    Allowlist, Finding)
 
-__all__ = ["RULES", "Allowlist", "Finding", "lint_paths", "Contract",
-           "ContractViolation", "RecompileGuard"]
+__all__ = ["RULES", "VERIFY_PASSES", "Allowlist", "Finding", "lint_paths",
+           "Contract", "ContractViolation", "RecompileGuard",
+           "AxisPolicy", "run_verify_suite"]
 
 
 def lint_paths(*args, **kwargs):
@@ -34,8 +45,12 @@ def lint_paths(*args, **kwargs):
 
 
 def __getattr__(name):
-    # Contract machinery imports jax — keep the AST layer import-light
+    # Contract/verify machinery imports jax — keep the AST layer
+    # import-light
     if name in ("Contract", "ContractViolation", "RecompileGuard"):
         from dgc_tpu.analysis import contracts
         return getattr(contracts, name)
+    if name in ("AxisPolicy", "run_verify_suite"):
+        from dgc_tpu.analysis import verify
+        return getattr(verify, name)
     raise AttributeError(name)

@@ -4,10 +4,15 @@ Modes
 -----
 default / ``--lint``   AST lints only (milliseconds, no jax import).
 ``--contracts``        compiled-program contract suite only.
-``--gate``             both — the CI entry wired into scripts/t1.sh.
+``--verify``           dgcver jaxpr dataflow passes (docs/ANALYSIS.md
+                       §Verifier); combines with any mode. ``--fast``
+                       skips its compile-needing donation pass.
+``--gate``             lints + contracts; with ``--verify`` this is the
+                       CI entry wired into scripts/t1.sh.
 
-Exit codes: 0 clean, 1 violations (un-allowlisted lint findings or any
-failed contract), 2 usage/internal error.
+Exit codes: 0 clean, 1 violations (un-allowlisted lint findings, any
+failed contract, or any un-waived verifier finding), 2 usage/internal
+error.
 """
 
 import argparse
@@ -40,6 +45,12 @@ def main(argv=None) -> int:
                     help="compiled-program contract suite only")
     ap.add_argument("--gate", action="store_true",
                     help="lints + contracts (CI mode)")
+    ap.add_argument("--verify", action="store_true",
+                    help="dgcver jaxpr dataflow passes (collective-axis, "
+                         "dtype-flow, donation-liveness, ef-conservation)")
+    ap.add_argument("--fast", action="store_true",
+                    help="with --verify: trace-only, skip the "
+                         "compile-needing donation pass + report")
     ap.add_argument("--allowlist", default=None, metavar="TOML",
                     help="override analysis/allowlist.toml")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -51,7 +62,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     do_contracts = args.contracts or args.gate
-    do_lint = args.lint or args.gate or not args.contracts
+    do_lint = args.lint or args.gate or not (args.contracts or args.verify)
     rc = 0
 
     if do_lint:
@@ -88,6 +99,27 @@ def main(argv=None) -> int:
         print(f"dgclint: contracts {len(results) - len(failed)}/"
               f"{len(results)} ok")
         if failed:
+            rc = 1
+
+    if args.verify:
+        _ensure_devices()
+        from dgc_tpu.analysis.verify import run_verify_suite
+        try:
+            allowlist = load_allowlist(args.allowlist)
+        except ValueError as e:
+            print(f"dgcver: bad allowlist: {e}", file=sys.stderr)
+            return 2
+        vresults = run_verify_suite(
+            log=lambda s: print(f"dgcver: {s}"), root=args.root,
+            fast=args.fast, allowlist=allowlist)
+        vfailed = [(n, v) for n, v in vresults if v]
+        for name, violations in vfailed:
+            print(f"VERIFY FAIL {name}")
+            for v in violations:
+                print(f"  - {v}")
+        print(f"dgcver: passes {len(vresults) - len(vfailed)}/"
+              f"{len(vresults)} ok")
+        if vfailed:
             rc = 1
 
     return rc

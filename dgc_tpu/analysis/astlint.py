@@ -359,6 +359,14 @@ class _FileLinter:
     def __init__(self, mod: _Module, findings: List[Finding]):
         self.mod = mod
         self.findings = findings
+        # module-level names some function mutates via `global` — reading
+        # one inside traced scope is the PR-6 "fresh-closure jaxpr-cache"
+        # hazard (DGC108): the first trace bakes the value in, later
+        # mutations are silently ignored by the cached program
+        self.mutable_globals: Set[str] = {
+            name
+            for node in ast.walk(mod.tree) if isinstance(node, ast.Global)
+            for name in node.names}
 
     def emit(self, rule: str, node: ast.AST, message: str) -> None:
         line = getattr(node, "lineno", 1)
@@ -462,11 +470,45 @@ class _FileLinter:
                   and n is not fn]
         skip = {id(x) for n in nested for x in ast.walk(n)}
 
+        # DGC108 scope prep: globals THIS function declares are its own
+        # mutation logic, and any locally bound name shadows the module
+        # flag — only un-shadowed reads of externally mutated flags fire
+        mut = self.mutable_globals - {
+            name for node in ast.walk(fn)
+            if isinstance(node, ast.Global) and id(node) not in skip
+            for name in node.names}
+        shadowed: Set[str] = set()
+        if mut:
+            a = fn.args
+            shadowed = {p.arg for p in (a.posonlyargs + a.args
+                                        + a.kwonlyargs)}
+            shadowed.update(p.arg for p in (a.vararg, a.kwarg) if p)
+            for node in ast.walk(fn):
+                if id(node) in skip:
+                    continue
+                if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                             ast.Store):
+                    shadowed.add(node.id)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) \
+                        and node is not fn:
+                    shadowed.add(node.name)
+
         for node in ast.walk(fn):
             if id(node) in skip:
                 continue
             if isinstance(node, ast.stmt):
                 taint.feed(node)
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Load) \
+                    and node.id in mut and node.id not in shadowed:
+                self.emit("mutable-closure", node,
+                          f"jitted scope reads module flag {node.id!r}, "
+                          "which another function mutates via `global` — "
+                          "the first trace bakes the value into the jaxpr "
+                          "cache and later mutations are silently ignored "
+                          "(pass it as a static arg or rebuild the "
+                          "closure per value)")
             if isinstance(node, (ast.If, ast.While)):
                 if taint.expr(node.test):
                     self.emit("tracer-branch", node,

@@ -25,8 +25,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["Rule", "RULES", "RULES_BY_ID", "Finding", "Allowlist",
-           "load_allowlist", "DEFAULT_ALLOWLIST_PATH"]
+__all__ = ["Rule", "RULES", "VERIFY_PASSES", "RULES_BY_ID", "Finding",
+           "Allowlist", "load_allowlist", "DEFAULT_ALLOWLIST_PATH"]
 
 DEFAULT_ALLOWLIST_PATH = os.path.join(os.path.dirname(__file__),
                                       "allowlist.toml")
@@ -66,12 +66,48 @@ RULES: Tuple[Rule, ...] = (
          "loop (float()/int()/.item()/device_get): stalls the dispatch "
          "pipeline every iteration — batch the reads after the loop",
          False),
+    Rule("mutable-closure", "DGC108",
+         "jitted function reads a module-level flag that some function "
+         "mutates via `global`: the first trace bakes the flag's value "
+         "into the jaxpr cache, so later mutations are silently ignored "
+         "(pass it as a static arg or rebuild the closure per value)",
+         True),
 )
 
-RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}
+#: dgcver verifier passes (docs/ANALYSIS.md §Verifier). Kept separate
+#: from RULES — the AST linter must not expect fixtures or dispatch for
+#: them — but registered in RULES_BY_ID so allowlist.toml entries and
+#: Finding.format() work identically for both layers.
+VERIFY_PASSES: Tuple[Rule, ...] = (
+    Rule("collective-axis", "DGCV01",
+         "collective runs over an axis missing from the AxisPolicy, has "
+         "no named axis at all, or pushes an axis past its per-axis "
+         "collective budget", True),
+    Rule("dtype-flow", "DGCV02",
+         "truncating cast (f32->bf16/f16/int) on a value tainted by an "
+         "f32 source (residual, momentum, guards, loss) whose narrow "
+         "flow never crosses a collective — precision silently lost "
+         "outside a wire lane", True),
+    Rule("donation-liveness", "DGCV03",
+         "state-shaped argument is dead after its first read but not "
+         "donated: the input buffer stays resident and doubles peak "
+         "HBM for that array", True),
+    Rule("ef-conservation", "DGCV04",
+         "error-feedback conservation broken: a selected gradient "
+         "element's flow does not reach both the wire payload and a "
+         "transmit-record/residual fold-back sink", True),
+)
 
-#: inline waiver: ``# dgclint: ok`` (any rule) or ``# dgclint: ok[id,id]``
-_WAIVER_RE = re.compile(r"#\s*dgclint:\s*ok(?:\[([a-z0-9_,\- ]+)\])?")
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES + VERIFY_PASSES}
+
+#: inline waivers: ``# dgclint: ok`` / ``# dgclint: ok[id,id]`` for the
+#: AST layer, ``# dgcver: ok`` / ``# dgcver: ok[pass-id]`` for verifier
+#: findings (matched against the source line the jaxpr provenance names)
+_WAIVER_RES = {
+    "dgclint": re.compile(r"#\s*dgclint:\s*ok(?:\[([a-z0-9_,\- ]+)\])?"),
+    "dgcver": re.compile(r"#\s*dgcver:\s*ok(?:\[([a-z0-9_,\- ]+)\])?"),
+}
+_WAIVER_RE = _WAIVER_RES["dgclint"]
 
 
 @dataclass
@@ -115,8 +151,9 @@ class Allowlist:
         return None
 
     @staticmethod
-    def inline_waiver(source_line: str, rule: str) -> bool:
-        m = _WAIVER_RE.search(source_line)
+    def inline_waiver(source_line: str, rule: str,
+                      tool: str = "dgclint") -> bool:
+        m = _WAIVER_RES[tool].search(source_line)
         if not m:
             return False
         if m.group(1) is None:

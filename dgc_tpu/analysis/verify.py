@@ -1,0 +1,559 @@
+"""dgcver: jaxpr-level dataflow verifier (analysis layer 3).
+
+The AST linter (layer 1) reads source; the contract suite (layer 2)
+counts ops and compares bytes in lowered text. Neither can answer the
+questions DGC's accuracy guarantee actually rests on (Lin et al., ICLR
+2018): *which axis* does each collective run over, does any f32 lane
+lose precision outside a wire codec, does the donated state actually
+die, and — the load-bearing one — does every selected gradient element
+provably reach both the wire and a transmit-record/residual sink so
+error feedback conserves mass. This module answers them statically, by
+taint analysis over the flattened jaxpr (:mod:`dgc_tpu.analysis.jaxpr`),
+seeded at the ``dgcver.*`` anchors the engine plants via
+``kernels.vtag`` (zero lowered ops — contracts see nothing).
+
+Four passes, gated as ``python -m dgc_tpu.analysis --gate --verify``:
+
+* **collective-axis** (DGCV01) — every collective in every pinned engine
+  config must name an axis from the declared :class:`AxisPolicy`, within
+  that axis's collective budget. Written mesh-aware: the future
+  ``(data, model)`` split is a policy edit, not a new pass.
+* **dtype-flow** (DGCV02) — values tainted by the f32 sources (residual,
+  momentum, guard counters, loss) must not take a truncating cast
+  (f32->bf16/f16/int) unless the narrowed flow crosses a collective
+  before re-widening — i.e. unless it IS a wire lane (int8/int4/f16
+  codecs quantize-before-gather by construction).
+* **donation-liveness** (DGCV03) — per compiled step: the
+  ``input_output_alias`` coverage of the state arguments, a
+  peak-live-bytes estimate from jaxpr liveness, and a finding for every
+  state-shaped dead-after-read argument left undonated on a build that
+  declared donation intent. Metrics land in ``runs/analysis_report.json``
+  for ``regress.py`` to gate.
+* **ef-conservation** (DGCV04) — taint the top-k selection outputs and
+  prove (C1) the value wire carries them, (C2) the index wire carries
+  them, and (C3) the transmit record OR the residual write-back depends
+  on them — the two legal fates of a selected element (deferred masking
+  keeps the velocity and masks next step via ``sent_bits``; int8 error
+  feedback folds the rounding residual back eagerly). Dense/all-dense
+  configs report ``dense`` and pass trivially.
+
+Waivers share one mechanism with dgclint: ``analysis/allowlist.toml``
+entries (reason required) and inline ``# dgcver: ok[pass-id]`` markers
+on the source line the equation provenance names.
+"""
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from dgc_tpu.analysis import jaxpr as jxa
+from dgc_tpu.analysis.hlo import donated_params
+from dgc_tpu.analysis.rules import (Allowlist, Finding, load_allowlist)
+
+__all__ = ["AxisPolicy", "DEFAULT_POLICY", "DEFAULT_REPORT_PATH",
+           "check_collective_axes", "check_dtype_flow",
+           "check_ef_conservation", "check_donation_liveness",
+           "run_verify_suite", "VERIFY_CONFIGS"]
+
+DEFAULT_REPORT_PATH = os.path.join("runs", "analysis_report.json")
+
+#: wire collectives a narrowed (wire-lane) flow may legitimately cross
+_WIRE_PRIMS = frozenset({"all_gather", "all_to_all", "reduce_scatter",
+                         "psum_scatter"})
+
+#: sources whose f32 chains the dtype-flow pass protects
+_SRC_PREFIX = "dgcver.src."
+
+
+@dataclass(frozen=True)
+class AxisPolicy:
+    """Declared mesh axes + per-axis collective budgets.
+
+    ``allowed`` — axis names collectives may run over. Today the engine
+    is data-parallel over ``data`` (plus the two-tier ``hosts``/``local``
+    split); a ``(data, model)`` mesh adds ``model`` here and a budget
+    row, nothing else. ``budgets`` — max collective equations per axis
+    per traced step (None = unbudgeted). This subsumes the contract
+    suite's raw op counts with per-axis resolution: a collective moved
+    onto the wrong axis used to look like "count unchanged"."""
+    allowed: frozenset = frozenset({"data"})
+    budgets: Dict[str, int] = field(default_factory=lambda: {"data": 8})
+
+
+DEFAULT_POLICY = AxisPolicy(
+    allowed=frozenset({"data", "hosts", "local"}),
+    budgets={"data": 8, "hosts": 8, "local": 4},
+)
+
+
+# --------------------------------------------------------------------- #
+# finding plumbing: provenance -> rules.Finding -> shared waivers       #
+# --------------------------------------------------------------------- #
+
+_SRC_RE = re.compile(r"^(.*?):(\d+)")
+
+
+def _mk_finding(pass_id: str, source: str, message: str,
+                root: str) -> Finding:
+    """Resolve an equation's ``file:line (fn)`` provenance into the same
+    Finding shape dgclint emits, so allowlist globs, inline waivers, and
+    formatting are one mechanism for both layers."""
+    path, line, snippet = "", 0, ""
+    m = _SRC_RE.match(source or "")
+    if m:
+        path, line = m.group(1), int(m.group(2))
+        full = path if os.path.isabs(path) else os.path.join(root, path)
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                for i, text in enumerate(f, 1):
+                    if i == line:
+                        snippet = text.strip()
+                        break
+        except OSError:
+            pass
+        try:
+            path = os.path.relpath(full, root)
+        except ValueError:
+            pass
+        path = path.replace(os.sep, "/")
+    return Finding(rule=pass_id, path=path, line=line, col=0,
+                   snippet=snippet, message=message)
+
+
+def _filter_waived(findings: Sequence[Finding],
+                   allowlist: Allowlist) -> List[str]:
+    """Formatted messages for the findings that survive waivers."""
+    out = []
+    for f in findings:
+        if f.snippet and Allowlist.inline_waiver(f.snippet, f.rule,
+                                                 tool="dgcver"):
+            continue
+        if allowlist.match(f) is not None:
+            continue
+        out.append(f.format())
+    return out
+
+
+# --------------------------------------------------------------------- #
+# pass 1: collective-axis audit                                         #
+# --------------------------------------------------------------------- #
+
+def check_collective_axes(prog: jxa.FlatProgram,
+                          policy: AxisPolicy = DEFAULT_POLICY,
+                          root: str = ".") -> List[Finding]:
+    """Every collective must name at least one axis, every named axis
+    must be in the policy, and no axis may exceed its budget."""
+    findings: List[Finding] = []
+    per_axis: Dict[str, int] = {}
+    sites = jxa.collectives(prog)
+    for s in sites:
+        if not s.axes:
+            findings.append(_mk_finding(
+                "collective-axis", s.source,
+                f"{s.prim} has no named mesh axis — unnamed collectives "
+                "can't be audited against the AxisPolicy (vmap axes are "
+                "fine elsewhere; the compiled step must name its axes)",
+                root))
+            continue
+        for ax in s.axes:
+            per_axis[ax] = per_axis.get(ax, 0) + 1
+            if ax not in policy.allowed:
+                findings.append(_mk_finding(
+                    "collective-axis", s.source,
+                    f"{s.prim} runs over undeclared axis {ax!r} "
+                    f"(AxisPolicy allows {sorted(policy.allowed)})", root))
+    for ax, n in per_axis.items():
+        budget = policy.budgets.get(ax)
+        if budget is not None and n > budget:
+            src = next((s.source for s in sites if ax in s.axes), "")
+            findings.append(_mk_finding(
+                "collective-axis", src,
+                f"axis {ax!r} carries {n} collectives, over its budget "
+                f"of {budget} — a new exchange leaked into the step", root))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# pass 2: dtype-flow                                                    #
+# --------------------------------------------------------------------- #
+
+def _dtype_of(aval):
+    import numpy as np
+    dt = getattr(aval, "dtype", None)
+    return np.dtype(dt) if dt is not None else None
+
+
+def _float_bits(dt) -> int:
+    """Float width in bits, 0 for non-floats. ml_dtypes extension
+    floats (bfloat16, float8_*) register as kind 'V' — name-match
+    them, or bf16 casts sail straight past a kind=='f' test."""
+    if dt is None:
+        return 0
+    if dt.kind == "f":
+        return dt.itemsize * 8
+    if dt.kind == "V" and dt.name.startswith(("bfloat", "float")):
+        return dt.itemsize * 8
+    return 0
+
+
+def _is_f32ish(dt) -> bool:
+    return _float_bits(dt) >= 32
+
+
+def _is_truncating(src_dt, dst_dt) -> bool:
+    """f32 -> {smaller float, any int}. bool is exempt (predicate
+    semantics — comparisons, masks — not a value representation)."""
+    if not _is_f32ish(src_dt) or dst_dt is None:
+        return False
+    bits = _float_bits(dst_dt)
+    if bits:
+        return bits < _float_bits(src_dt)
+    return dst_dt.kind in ("i", "u")
+
+
+def check_dtype_flow(prog: jxa.FlatProgram, root: str = ".",
+                     ) -> List[Finding]:
+    """Truncating casts on f32-source-tainted values must be wire lanes:
+    the narrowed flow (followed until re-widened to >=f32) has to cross
+    a gather-class collective. A narrow-then-immediately-rewiden chain
+    never leaves the chip — that's silent precision loss, not a codec."""
+    import numpy as np
+
+    seeds: Set[int] = set()
+    for name, eqns in jxa.tags(prog).items():
+        if name.startswith(_SRC_PREFIX):
+            for e in eqns:
+                seeds.update(e.outvars)
+    if not seeds:
+        return []
+    tainted = jxa.forward_taint(prog, seeds)
+
+    def _not_rewiden(e: jxa.FlatEqn) -> bool:
+        if e.prim != "convert_element_type":
+            return True
+        dst = e.params.get("new_dtype")
+        return not _is_f32ish(np.dtype(dst) if dst is not None else None)
+
+    findings: List[Finding] = []
+    for e in prog.eqns:
+        if e.prim != "convert_element_type" or not e.invars:
+            continue
+        if e.invars[0] not in tainted:
+            continue
+        src_dt = _dtype_of(prog.avals.get(e.invars[0]))
+        dst = e.params.get("new_dtype")
+        dst_dt = np.dtype(dst) if dst is not None else None
+        if not _is_truncating(src_dt, dst_dt):
+            continue
+        narrow = jxa.forward_taint(prog, set(e.outvars),
+                                   through=_not_rewiden)
+        crosses = any(
+            any(v in narrow for v in c.invars)
+            for c in prog.eqns if c.prim in _WIRE_PRIMS)
+        if not crosses:
+            findings.append(_mk_finding(
+                "dtype-flow", e.source,
+                f"truncating cast {src_dt} -> {dst_dt} on an f32-source-"
+                "tainted value whose narrowed flow never crosses a "
+                "collective — precision silently lost outside a wire "
+                "lane", root))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# pass 4: error-feedback conservation                                   #
+# --------------------------------------------------------------------- #
+
+def check_ef_conservation(prog: jxa.FlatProgram, root: str = ".",
+                          descriptor: Optional[Dict] = None,
+                          ) -> Tuple[str, List[Finding]]:
+    """Returns (status, findings). status: ``"ok"`` (all three checks
+    hold), ``"dense"`` (no sparse selection in this program — all-dense
+    plan or dense engine, trivially conserved), or ``"broken"``.
+
+    ``descriptor`` — an optional ``Plan.verify_descriptor()``: when the
+    plan promises a sparse selection, tracing dense is itself a failure,
+    and an fp32 plan (``eager_foldback=False``) must conserve through
+    the *deferred* transmit record specifically — an eager-looking pass
+    there would mean the velocity write-back is aliasing something else."""
+    tag_map = jxa.tags(prog)
+    sel_v = [v for e in tag_map.get("dgcver.sel_values", ())
+             for v in e.outvars]
+    sel_i = [v for e in tag_map.get("dgcver.sel_indices", ())
+             for v in e.outvars]
+    if not sel_v and not sel_i:
+        if descriptor and descriptor.get("conservation") == "sparse":
+            return "broken", [_mk_finding(
+                "ef-conservation", "",
+                "plan descriptor promises a sparse selection but the "
+                "traced step plants none — the engine compiled the "
+                "dense fallback against a sparse plan", root)]
+        return "dense", []
+
+    findings: List[Finding] = []
+    v_taint = jxa.forward_taint(prog, sel_v)
+    i_taint = jxa.forward_taint(prog, sel_i)
+    gathers = [e for e in prog.eqns if e.prim in _WIRE_PRIMS]
+    sel_src = next((e.source
+                    for e in tag_map.get("dgcver.sel_values", ())), "")
+
+    # C1: the selected VALUES reach a wire collective (payload lane)
+    if not any(any(v in v_taint for v in g.invars) for g in gathers):
+        findings.append(_mk_finding(
+            "ef-conservation", sel_src,
+            "C1 broken: no collective input depends on the selected "
+            "values — the payload never reaches the wire", root))
+    # C2: the selected INDICES reach a wire collective (index lane)
+    if not any(any(v in i_taint for v in g.invars) for g in gathers):
+        findings.append(_mk_finding(
+            "ef-conservation", sel_src,
+            "C2 broken: no collective input depends on the selected "
+            "indices — peers can't place the payload", root))
+    # C3: a selected element's OTHER fate — not transmitted, or int8
+    # rounding error — must land back in local state. Two legal
+    # mechanisms, either suffices: the deferred transmit record
+    # (sent_bits depends on the indices; next compensate masks) or the
+    # eager residual fold-back (velocities scatter-updated at the
+    # selected coordinates, int8 error feedback)
+    bits_in = [v for e in tag_map.get("dgcver.sink.sent_bits", ())
+               for v in e.invars]
+    resid_in = [v for e in tag_map.get("dgcver.sink.residual", ())
+                for v in e.invars]
+    bits_src = next((e.source
+                     for e in tag_map.get("dgcver.sink.sent_bits", ())),
+                    sel_src)
+    deferred = any(v in i_taint for v in bits_in)
+    eager = any(v in i_taint for v in resid_in)
+    if (descriptor is not None
+            and not descriptor.get("eager_foldback", True)
+            and not deferred):
+        findings.append(_mk_finding(
+            "ef-conservation", bits_src,
+            "C3 broken for an fp32 plan: the transmit record (sent_bits) "
+            "does not depend on the selected indices — fp32 regimes "
+            "conserve through deferred masking, and that record is the "
+            "only fold-back they have", root))
+    elif not (deferred or eager):
+        findings.append(_mk_finding(
+            "ef-conservation", bits_src,
+            "C3 broken: neither the transmit record (sent_bits) nor the "
+            "residual write-back depends on the selected indices — "
+            "untransmitted selection mass is lost instead of folded "
+            "back (error feedback no longer conserves)", root))
+    return ("ok" if not findings else "broken"), findings
+
+
+# --------------------------------------------------------------------- #
+# pass 3: donation / liveness                                           #
+# --------------------------------------------------------------------- #
+
+def check_donation_liveness(prog: jxa.FlatProgram, compiled_text: str,
+                            n_state_leaves: int, declared_donate: bool,
+                            root: str = ".",
+                            ) -> Tuple[Dict[str, float], List[Finding]]:
+    """Returns (metrics, findings) for one compiled step.
+
+    ``alias_coverage`` = donated params / state-arg leaves (the state is
+    the flat-args prefix — jit flattens ``(state, images, labels, key)``
+    in order). A state-shaped param (its aval matches some output's)
+    that is dead after its read and NOT in the alias header is a finding
+    on builds that declared donation intent."""
+    donated = set(donated_params(compiled_text))
+    n_state = max(1, n_state_leaves)
+    coverage = min(1.0, len(donated) / n_state)
+    metrics = {
+        "alias_coverage": round(coverage, 4),
+        "peak_live_bytes": float(jxa.peak_live_bytes(prog)),
+    }
+    findings: List[Finding] = []
+    if not declared_donate:
+        return metrics, findings
+
+    out_avals = set()
+    for v in prog.outvars:
+        a = prog.avals.get(v)
+        if a is not None:
+            out_avals.add((getattr(a, "shape", None),
+                           str(getattr(a, "dtype", ""))))
+    passthrough = set(prog.outvars)
+    for pos, v in enumerate(prog.invars[:n_state_leaves]):
+        if pos in donated or v is None or v in passthrough:
+            continue
+        a = prog.avals.get(v)
+        key = (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+        if key not in out_avals:
+            continue        # not state-shaped: no output could alias it
+        findings.append(_mk_finding(
+            "donation-liveness", "",
+            f"state arg #{pos} (shape {key[0]}, {key[1]}) is dead after "
+            "read but not donated — its input buffer stays resident "
+            "(donate_argnums covers the state; check for a stale "
+            "reference keeping it undonatable)", root))
+    if not donated:
+        findings.append(_mk_finding(
+            "donation-liveness", "",
+            "donation declared but the compiled module aliases nothing "
+            "(input_output_alias header empty)", root))
+    return metrics, findings
+
+
+# --------------------------------------------------------------------- #
+# the verify suite: every pinned engine configuration                   #
+# --------------------------------------------------------------------- #
+
+def _configs():
+    """(name, needs_clock, fixture_kwargs_thunk) for every pinned engine
+    configuration. Thunks defer jax-heavy imports to call time."""
+    def plan_for(reg):
+        from dgc_tpu.compression.planner import plan_buckets
+        return plan_buckets([], fabric="32x25GbE", world=8,
+                            candidates=(reg,))
+
+    cfgs = [
+        ("plain", False, lambda: dict(donate=False, telemetry=False)),
+        ("telemetry", False, lambda: dict(donate=False, telemetry=True)),
+        ("fused_apply", False, lambda: dict(
+            donate=False, telemetry=False,
+            compressor_kwargs={"fused_apply": True})),
+        ("fused_select", False, lambda: dict(
+            donate=False, telemetry=False,
+            compressor_kwargs={"fused_select": True})),
+        ("fleet", True, lambda: dict(donate=False, telemetry=True,
+                                     fleet=True)),
+        ("adaptive", True, lambda: _adaptive_kwargs()),
+    ]
+    for reg in ("fp32", "int8", "int8_packed", "int4_packed",
+                "int8_delta_idx"):
+        cfgs.append((f"planned.{reg}", False,
+                     lambda reg=reg: dict(donate=False, telemetry=False,
+                                          plan=plan_for(reg))))
+    return cfgs
+
+
+def _adaptive_kwargs():
+    from dgc_tpu.resilience.adaptive import AdaptiveConfig
+    return dict(donate=False, telemetry=True, fleet=True,
+                adaptive=AdaptiveConfig())
+
+
+VERIFY_CONFIGS = tuple(name for name, _, _ in _configs())
+
+
+def _trace_prog(step, args) -> jxa.FlatProgram:
+    import jax
+    return jxa.flatten(jax.make_jaxpr(step)(*args))
+
+
+def run_verify_suite(mesh=None, log: Callable[[str], None] = None,
+                     root: Optional[str] = None, fast: bool = False,
+                     allowlist: Optional[Allowlist] = None,
+                     policy: AxisPolicy = DEFAULT_POLICY,
+                     report_path: Optional[str] = None,
+                     ) -> List[Tuple[str, List[str]]]:
+    """Run the four verifier passes over every pinned engine config.
+
+    Returns ``(name, violations)`` pairs like ``run_contract_suite``.
+    ``fast`` skips the compile-needing donation pass (and report
+    emission) — jaxpr tracing only, for ``scripts/lint.sh --fast``.
+    The full run writes ``runs/analysis_report.json`` under ``root``
+    with the metrics ``regress.py`` gates."""
+    import jax
+
+    from dgc_tpu.analysis.suite import build_fixture
+    from dgc_tpu.parallel import make_mesh
+
+    say = log or (lambda s: None)
+    root = root or os.getcwd()
+    allowlist = allowlist if allowlist is not None else load_allowlist()
+    if mesh is None:
+        mesh = make_mesh(8)
+    results: List[Tuple[str, List[str]]] = []
+    report: Dict = {"schema": "dgc-analysis-report-v1", "configs": {}}
+
+    for name, needs_clock, kw_thunk in _configs():
+        say(f"verify: {name}")
+        try:
+            state, step, setup, (images, labels, key) = build_fixture(
+                mesh, **kw_thunk())
+            args = (state, images, labels, key)
+            if needs_clock:
+                from dgc_tpu.telemetry import fleet as _fleet
+                args = args + (_fleet.make_clock(0.0, mesh, 8),)
+            prog = _trace_prog(step, args)
+        except Exception as e:
+            results.append((f"verify[{name}]",
+                            [f"errored: {type(e).__name__}: {e}"]))
+            continue
+
+        # the engine re-fits any Plan to the fixture geometry; its
+        # verify_descriptor() carries the static promises we check
+        eng_plan = getattr(getattr(setup, "engine", None), "plan", None)
+        desc = (eng_plan.verify_descriptor()
+                if eng_plan is not None else None)
+
+        ax = check_collective_axes(prog, policy, root)
+        if desc is not None:
+            observed = sum(1 for e in prog.eqns if e.prim in _WIRE_PRIMS)
+            if observed != desc["gather_lanes"]:
+                src = next((s.source for s in jxa.collectives(prog)
+                            if s.prim in _WIRE_PRIMS), "")
+                ax.append(_mk_finding(
+                    "collective-axis", src,
+                    f"plan descriptor predicts {desc['gather_lanes']} "
+                    f"wire-gather lanes but the traced step lowers "
+                    f"{observed} — the engine's lane construction drifted "
+                    "from Plan.num_gathers", root))
+        results.append((f"verify[{name}].collective-axis",
+                        _filter_waived(ax, allowlist)))
+        df = check_dtype_flow(prog, root)
+        results.append((f"verify[{name}].dtype-flow",
+                        _filter_waived(df, allowlist)))
+        status, ef = check_ef_conservation(prog, root, descriptor=desc)
+        results.append((f"verify[{name}].ef-conservation",
+                        _filter_waived(ef, allowlist)))
+        report["configs"][name] = {
+            "conservation": status,
+            "peak_live_bytes": jxa.peak_live_bytes(prog),
+            "collectives": sorted(
+                f"{s.prim}@{','.join(s.axes)}"
+                for s in jxa.collectives(prog)),
+        }
+        if desc is not None:
+            report["configs"][name]["plan"] = {
+                k: list(v) if isinstance(v, tuple) else v
+                for k, v in desc.items()}
+
+    # donation pass: one compile, on the donated build
+    if not fast:
+        say("verify: donated (compile)")
+        try:
+            state, step, _, (images, labels, key) = build_fixture(
+                mesh, donate=True)
+            args = (state, images, labels, key)
+            prog = _trace_prog(step, args)
+            compiled = step.lower(*args).compile().as_text()
+            n_state = len(jax.tree_util.tree_leaves(state))
+            metrics, dn = check_donation_liveness(
+                prog, compiled, n_state, declared_donate=True, root=root)
+            results.append(("verify[donated].donation-liveness",
+                            _filter_waived(dn, allowlist)))
+            report.update(metrics)
+            report["configs"]["donated"] = {
+                "alias_coverage": metrics["alias_coverage"],
+                "peak_live_bytes": int(metrics["peak_live_bytes"]),
+            }
+        except Exception as e:
+            results.append(("verify[donated].donation-liveness",
+                            [f"errored: {type(e).__name__}: {e}"]))
+
+        path = report_path or os.path.join(root, DEFAULT_REPORT_PATH)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+            say(f"verify: report -> {path}")
+        except OSError as e:
+            results.append(("verify.report", [f"unwritable: {e}"]))
+    return results

@@ -36,6 +36,7 @@ decompress, momentum correction and masking per SURVEY.md §2.3-2.5.
 """
 
 import math
+import os
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -1924,6 +1925,10 @@ class FlatDGCEngine:
             flat_grad = jax.lax.psum(flat_grad, local_axis)
             if op in ("average", "adasum"):
                 flat_grad = flat_grad / local_size
+        # dgcver anchors (analysis/verify.py): identity `name` tags that
+        # seed/sink the verifier's static taint passes. Zero HLO ops —
+        # every byte-identity and collective-count contract is unchanged.
+        flat_grad = kernels.vtag(flat_grad, "dgcver.src.grad")
         T, P = self.T, self.layout.total
         m = self._mem
         clip = m.gradient_clipping if m is not None else None
@@ -1958,7 +1963,8 @@ class FlatDGCEngine:
             # reference zeroed those coords at the compressed step,
             # memory.py:72-77), and reset it — carrying it forward would
             # wrongly zero the dense momentum written below
-            mc, vc = mem["momentums_c"], mem["velocities_c"]
+            mc = kernels.vtag(mem["momentums_c"], "dgcver.src.momentum")
+            vc = kernels.vtag(mem["velocities_c"], "dgcver.src.residual")
             bits = mem.get("sent_bits")
             if m is not None and T and bits is not None:
                 keep = kernels.keep_from_bits(bits, T).astype(vc.dtype)
@@ -1982,7 +1988,8 @@ class FlatDGCEngine:
 
         gc, gd = flat_grad[:T], flat_grad[T:]
         if m is not None:
-            mc, vc = mem["momentums_c"], mem["velocities_c"]
+            mc = kernels.vtag(mem["momentums_c"], "dgcver.src.momentum")
+            vc = kernels.vtag(mem["velocities_c"], "dgcver.src.residual")
             md = mem["momentums_d"]
         else:
             mc = vc = md = None
@@ -2024,9 +2031,18 @@ class FlatDGCEngine:
                     want_cands=self._seg_fused)
         else:
             comp = gc
+        if os.environ.get("DGC_VERIFY_MUTATE", "") == "cast_bf16":
+            # seeded mutation (tests/test_analysis_verify.py): a silent
+            # precision drop on the compensated gradient — the dgcver
+            # dtype-flow pass must turn the gate red on this
+            comp = comp.astype(jnp.bfloat16).astype(flat_grad.dtype)
         sel_stats: Optional[Dict] = {} if telemetry else None
         values, indices = self.sparsify(comp, key, seg_cands=cands,
                                         stats_out=sel_stats)
+        # tag the selection BEFORE the adaptive mask: masked derivations
+        # must stay tainted so conservation covers the withheld tail too
+        values = kernels.vtag(values, "dgcver.sel_values")
+        indices = kernels.vtag(indices, "dgcver.sel_indices")
         if send_frac is not None and self._adaptive_rank is not None:
             # straggler-adaptive masking (resilience/adaptive.py): keep
             # only each row's ceil(quota * send_frac) largest selections;
@@ -2349,6 +2365,13 @@ class FlatDGCEngine:
                             indices)
                         new_bits = kernels.pack_sent_bits(
                             rec, T, sentinel=self.layout.sentinel)
+                    elif (os.environ.get("DGC_VERIFY_MUTATE", "")
+                          == "drop_foldback"):
+                        # seeded mutation: lose the transmit record, so
+                        # the next compensate re-sends what the wire
+                        # already carried — the dgcver ef-conservation
+                        # pass must turn the gate red on this
+                        new_bits = jnp.zeros_like(mem["sent_bits"])
                     else:
                         new_bits = kernels.pack_sent_bits(
                             indices, T, sentinel=self.layout.sentinel)
@@ -2411,9 +2434,11 @@ class FlatDGCEngine:
             out = acc
 
         if m is not None:
-            mem = {"momentums_c": mc, "velocities_c": vc,
+            mem = {"momentums_c": kernels.vtag(mc, "dgcver.sink.momentum"),
+                   "velocities_c": kernels.vtag(vc, "dgcver.sink.residual"),
                    "momentums_d": md, "velocities_d": mem["velocities_d"],
-                   "sent_bits": new_bits}
+                   "sent_bits": kernels.vtag(new_bits,
+                                             "dgcver.sink.sent_bits")}
         if telemetry:
             # transmitted energy from the live payload (invalid slots carry
             # 0.0): under deferred masking vc still holds the transmitted

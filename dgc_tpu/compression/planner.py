@@ -442,6 +442,23 @@ class Plan:
         return {"all-gather": self.num_gathers,
                 "all-reduce": int(dense_reduces)}
 
+    def verify_descriptor(self) -> Dict[str, object]:
+        """Static expectations the dgcver verifier checks the traced step
+        against (docs/ANALYSIS.md §Verifier): predicted wire-gather lane
+        count, whether a sparse selection must appear at all, and which
+        error-feedback fold-back mechanism conservation should find —
+        quantizing regimes fold rounding residual back eagerly, fp32
+        defers via the ``sent_bits`` transmit record."""
+        sp = self.sparse_regimes
+        kinds = {_value_kind(r) for r in sp}
+        return {
+            "gather_lanes": self.num_gathers,
+            "conservation": "dense" if not sp else "sparse",
+            "value_kinds": tuple(sorted(kinds)),
+            "packed_words": any(_uses_words(r) for r in sp),
+            "eager_foldback": bool(kinds & {"i8", "i4"}),
+        }
+
     # -- prediction ----------------------------------------------- #
 
     def predicted_ms(self) -> Dict[str, float]:

@@ -89,7 +89,10 @@ class TorchDGCBridge:
                 out_specs=(P(), P(axis)),
                 check_vma=False)(flat_w, mem_w, key)
 
-        self._exchange = jax.jit(_exchange)
+        # mem is dead after each call (exchange() rebinds self.mem to the
+        # returned tree), so donating it halves the bridge's resident
+        # DGC-state HBM (flagged by the dgcver donation-liveness pass)
+        self._exchange = jax.jit(_exchange, donate_argnums=(1,))
         from jax.sharding import NamedSharding, PartitionSpec as P
         self._data_sharding = NamedSharding(self.mesh, P(axis))
         self._repl_sharding = NamedSharding(self.mesh, P())

@@ -192,6 +192,16 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
                "slowest worker: max(w_clock) - median(w_clock), ms "
                "(bench.py fleet.straggler_stall_ms) — the quantity the "
                "adaptive exchange exists to shrink", better="lower"),
+    MetricSpec("alias_coverage", "scalar",
+               "donated-param fraction of the state leaves in the compiled "
+               "step's input_output_alias header (dgcver donation pass, "
+               "runs/analysis_report.json) — dropping below baseline means "
+               "a state buffer stopped being donated", better="higher"),
+    MetricSpec("peak_live_bytes", "scalar",
+               "peak simultaneously-live bytes over the traced step by "
+               "jaxpr liveness (dgcver donation pass, "
+               "runs/analysis_report.json) — a static proxy for step HBM "
+               "high-water", better="lower"),
 )
 
 

@@ -52,7 +52,11 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
     out: Dict[str, float] = {}
     if isinstance(obj.get("value"), (int, float)):
         out["exchange_ms"] = float(obj["value"])
-    for k in ("overhead_ms", "step_time_ms", "wire_bytes", "payload_elems"):
+    # alias_coverage / peak_live_bytes are top-level in the dgcver
+    # analysis report (runs/analysis_report.json), which this reader
+    # accepts like any other one-object bench artifact
+    for k in ("overhead_ms", "step_time_ms", "wire_bytes", "payload_elems",
+              "alias_coverage", "peak_live_bytes"):
         if isinstance(obj.get(k), (int, float)):
             out[k] = float(obj[k])
     # nested fabric-regime ratios (higher is better; see registry)

@@ -401,6 +401,9 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
                 grads, state.params, opt_state0, memory, sparsify_key,
                 send_frac=frac)
 
+        # dgcver dtype-flow anchor (analysis/verify.py): the loss lane is
+        # an f32 source — zero HLO ops, contracts unchanged
+        loss = kernels.vtag(loss, "dgcver.src.loss")
         if guards is not None:
             # the per-worker badness flag rides the loss all-reduce as a
             # stacked [2] vector — same collective count as unguarded,
@@ -441,8 +444,11 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             new_adaptive = state.adaptive
 
         if guards is not None:
+            # dgcver anchor: guard counters are f32 sources too (tagged
+            # only on guarded builds, so guards-off stays untouched)
             skip, gstate, gmetrics = _guard.apply(
-                guards, state.guards, bad_count=bad_count,
+                guards, kernels.vtag(state.guards, "dgcver.src.guards"),
+                bad_count=bad_count,
                 mean_loss=mean_loss,
                 checksum_failures=(health or {}).get("checksum_failures"))
             # ATOMIC skip: every piece of the update reverts together —

@@ -1,9 +1,17 @@
 #!/usr/bin/env bash
 # Fast AST-only dgclint pass (no jax import, milliseconds) — the
-# edit-loop companion to the full `python -m dgc_tpu.analysis --gate`
-# wired into scripts/t1.sh. Extra args pass through, e.g.:
+# edit-loop companion to the full `python -m dgc_tpu.analysis --gate
+# --verify` wired into scripts/t1.sh. Extra args pass through, e.g.:
 #   scripts/lint.sh --show-allowed
 #   scripts/lint.sh bench.py scripts   # lint beyond the default roots
+#   scripts/lint.sh --fast             # lint + trace-only dgcver passes
+#                                      # (skips the compile-needing
+#                                      # donation pass; a few seconds)
 set -e
 cd "$(dirname "$0")/.."
+if [[ "$1" == "--fast" ]]; then
+    shift
+    exec env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis \
+        --lint --verify --fast "$@"
+fi
 exec python -m dgc_tpu.analysis --lint "$@"

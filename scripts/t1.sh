@@ -80,10 +80,18 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_adaptive.py 
   "tests/test_multiprocess.py::test_fleet_two_process_adaptive" \
   -q -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "ADAPTIVE_SMOKE=ok" || { echo "ADAPTIVE_SMOKE=FAIL"; rc=1; }
+# dgcver wall-clock budget (docs/ANALYSIS.md §Verifier): the full verify
+# suite — trace + 4 passes over every pinned config, one donated compile,
+# report emission — must finish inside 60 s on the CPU mesh, so the
+# verifier can only ever make the tier-1 gate marginally slower
+timeout -k 10 60 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --verify \
+  && echo "VERIFY_BUDGET=ok" || { echo "VERIFY_BUDGET=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
-# compiled-program contract suite — nonzero on any un-allowlisted finding
-# or broken step invariant (one sparse exchange, telemetry compiles away,
-# donation aliases, barrier-free fused epilogue)
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --gate \
+# compiled-program contract suite + the dgcver jaxpr dataflow verifier
+# (collective-axis/dtype-flow/donation/ef-conservation over every pinned
+# engine config) — nonzero on any un-allowlisted finding or broken step
+# invariant (one sparse exchange, telemetry compiles away, donation
+# aliases, barrier-free fused epilogue, error feedback conserves)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --gate --verify \
   && echo "ANALYSIS_GATE=ok" || { echo "ANALYSIS_GATE=FAIL"; rc=1; }
 exit $rc

@@ -1,0 +1,32 @@
+"""DGC108 negative: the flag reaches traced scope as a static argument
+(retrace per value — correct), the host-side reader is never traced,
+and a local binding shadowing the module name is not a closure read."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_FAST_MATH = False
+
+
+def set_fast_math(on):
+    global _FAST_MATH
+    _FAST_MATH = on
+
+
+@partial(jax.jit, static_argnames=("fast",))
+def scale(x, fast: bool = False):
+    factor = 2.0 if fast else 1.0
+    return x * jnp.float32(factor)
+
+
+@jax.jit
+def scale_local(x):
+    _FAST_MATH = True           # local shadow, not the module flag
+    return x * jnp.float32(2.0 if _FAST_MATH else 1.0)
+
+
+def current_mode():
+    # host-side read: nothing is traced here, mutation is visible
+    return "fast" if _FAST_MATH else "exact"

@@ -1,0 +1,20 @@
+"""DGC108 positive: jitted scope reads a module flag that another
+function mutates via ``global`` — the PR-6 "fresh-closure jaxpr-cache"
+hazard. The first trace bakes ``_FAST_MATH``'s value into the cached
+program; ``set_fast_math(True)`` afterwards changes nothing."""
+
+import jax
+import jax.numpy as jnp
+
+_FAST_MATH = False
+
+
+def set_fast_math(on):
+    global _FAST_MATH
+    _FAST_MATH = on
+
+
+@jax.jit
+def scale(x):
+    factor = 2.0 if _FAST_MATH else 1.0  # LINT: mutable-closure
+    return x * jnp.float32(factor)

@@ -1,0 +1,339 @@
+"""dgcver layer 3: jaxpr traversal + the four dataflow passes.
+
+Toy traced programs pin each pass's detection logic in isolation; the
+seeded-mutation tests prove the passes stay wired to the *real* engine
+(`DGC_VERIFY_MUTATE` flips a hostile edit into flat.py at trace time and
+the right pass must go red, naming the source line); the suite test pins
+the whole gate green on every pinned config."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dgc_tpu.analysis import jaxpr as jxa
+from dgc_tpu.analysis import verify
+from dgc_tpu.analysis.rules import Allowlist, load_allowlist
+from dgc_tpu.analysis.verify import (AxisPolicy, check_collective_axes,
+                                     check_donation_liveness,
+                                     check_dtype_flow,
+                                     check_ef_conservation,
+                                     run_verify_suite)
+from dgc_tpu.ops import kernels
+from dgc_tpu.utils.compat import shard_map
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _prog(fn, *args):
+    return jxa.flatten(jax.make_jaxpr(fn)(*args))
+
+
+# --------------------------------------------------------------------- #
+# traversal layer                                                        #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_flatten_recurses_into_pjit_and_scan():
+    def inner(x):
+        return x * 2.0
+
+    def f(x):
+        y = jax.jit(inner)(x)
+
+        def body(c, _):
+            return c + y, None
+
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    prog = _prog(f, jnp.ones((4,)))
+    prims = {e.prim for e in prog.eqns}
+    # the mul inside pjit and the add inside scan are both visible flat
+    assert "mul" in prims and "add" in prims
+    assert all(e.source for e in prog.eqns if e.prim == "mul")
+
+
+@pytest.mark.fast
+def test_collectives_extract_axis_names(mesh8):
+    def worker(x):
+        return jax.lax.psum(x, "data")
+
+    f = shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
+                  out_specs=P("data"), check_vma=False)
+    prog = _prog(f, jnp.ones((8, 4)))
+    sites = jxa.collectives(prog)
+    assert sites and all("data" in s.axes for s in sites)
+
+
+@pytest.mark.fast
+def test_tags_and_forward_taint():
+    def f(x):
+        y = kernels.vtag(x * 2.0, "dgcver.src.test")
+        z = y + 1.0
+        w = x - 3.0          # independent of the tagged value
+        return z, w
+
+    prog = _prog(f, jnp.ones((4,)))
+    tag_map = jxa.tags(prog)
+    assert "dgcver.src.test" in tag_map
+    seeds = {v for e in tag_map["dgcver.src.test"] for v in e.outvars}
+    tainted = jxa.forward_taint(prog, seeds)
+    z_var, w_var = prog.outvars[0], prog.outvars[1]
+    assert z_var in tainted
+    assert w_var not in tainted
+
+
+@pytest.mark.fast
+def test_peak_live_bytes_positive():
+    def f(x):
+        return (x * 2.0).sum()
+
+    prog = _prog(f, jnp.ones((128,)))
+    peak = jxa.peak_live_bytes(prog)
+    assert peak >= 128 * 4
+
+
+# --------------------------------------------------------------------- #
+# pass 1: collective-axis                                                #
+# --------------------------------------------------------------------- #
+
+def _psum_prog(mesh8):
+    def worker(x):
+        return jax.lax.psum(x, "data")
+
+    f = shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
+                  out_specs=P("data"), check_vma=False)
+    return _prog(f, jnp.ones((8, 4)))
+
+
+@pytest.mark.fast
+def test_collective_axis_allowed(mesh8):
+    prog = _psum_prog(mesh8)
+    pol = AxisPolicy(allowed=frozenset({"data"}), budgets={"data": 4})
+    assert check_collective_axes(prog, pol, REPO_ROOT) == []
+
+
+@pytest.mark.fast
+def test_collective_axis_undeclared_axis_flagged(mesh8):
+    prog = _psum_prog(mesh8)
+    pol = AxisPolicy(allowed=frozenset({"model"}), budgets={})
+    findings = check_collective_axes(prog, pol, REPO_ROOT)
+    assert findings and "undeclared axis 'data'" in findings[0].message
+
+
+@pytest.mark.fast
+def test_collective_axis_budget_enforced(mesh8):
+    prog = _psum_prog(mesh8)
+    pol = AxisPolicy(allowed=frozenset({"data"}), budgets={"data": 0})
+    findings = check_collective_axes(prog, pol, REPO_ROOT)
+    assert any("over its budget" in f.message for f in findings)
+
+
+# --------------------------------------------------------------------- #
+# pass 2: dtype-flow                                                     #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_dtype_flow_flags_onchip_bf16_roundtrip():
+    def f(x):
+        r = kernels.vtag(x, "dgcver.src.residual")
+        return r.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+
+    findings = check_dtype_flow(_prog(f, jnp.ones((8,))), REPO_ROOT)
+    assert findings and "truncating cast" in findings[0].message
+
+
+@pytest.mark.fast
+def test_dtype_flow_allows_wire_lane_narrowing(mesh8):
+    def worker(x):
+        r = kernels.vtag(x, "dgcver.src.residual")
+        q = r.astype(jnp.bfloat16)          # narrow...
+        g = jax.lax.all_gather(q, "data")   # ...but it IS the wire
+        return g.astype(jnp.float32).sum()
+
+    f = shard_map(worker, mesh=mesh8, in_specs=(P("data"),),
+                  out_specs=P(), check_vma=False)
+    assert check_dtype_flow(_prog(f, jnp.ones((8, 4))), REPO_ROOT) == []
+
+
+@pytest.mark.fast
+def test_dtype_flow_ignores_untainted_casts():
+    def f(x):
+        kernels.vtag(x + 2.0, "dgcver.src.residual")  # tainted lane unused
+        return (x * 3.0).astype(jnp.bfloat16)
+
+    assert check_dtype_flow(_prog(f, jnp.ones((8,))), REPO_ROOT) == []
+
+
+# --------------------------------------------------------------------- #
+# pass 3: donation / liveness                                            #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_donation_liveness_on_donated_toy():
+    def f(state, x):
+        return state + x, (state * x).sum()
+
+    state, x = jnp.ones((16,)), jnp.ones((16,))
+    prog = _prog(f, state, x)
+    text = (jax.jit(f, donate_argnums=(0,))
+            .lower(state, x).compile().as_text())
+    metrics, findings = check_donation_liveness(
+        prog, text, n_state_leaves=1, declared_donate=True, root=REPO_ROOT)
+    assert metrics["alias_coverage"] == 1.0
+    assert metrics["peak_live_bytes"] > 0
+    assert findings == []
+
+
+@pytest.mark.fast
+def test_donation_liveness_flags_empty_alias_header():
+    def f(state, x):
+        return state + x, (state * x).sum()
+
+    state, x = jnp.ones((16,)), jnp.ones((16,))
+    prog = _prog(f, state, x)
+    text = jax.jit(f).lower(state, x).compile().as_text()  # no donation
+    metrics, findings = check_donation_liveness(
+        prog, text, n_state_leaves=1, declared_donate=True, root=REPO_ROOT)
+    assert metrics["alias_coverage"] == 0.0
+    assert findings  # dead-after-read state arg and/or empty alias header
+
+
+# --------------------------------------------------------------------- #
+# pass 4: ef-conservation (+ the Plan descriptor hook)                   #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_ef_conservation_dense_program_trivially_ok():
+    status, findings = check_ef_conservation(
+        _prog(lambda x: x * 2.0, jnp.ones((4,))), REPO_ROOT)
+    assert status == "dense" and findings == []
+
+
+@pytest.mark.fast
+def test_ef_conservation_descriptor_rejects_dense_under_sparse_plan():
+    desc = {"conservation": "sparse", "eager_foldback": False}
+    status, findings = check_ef_conservation(
+        _prog(lambda x: x * 2.0, jnp.ones((4,))), REPO_ROOT,
+        descriptor=desc)
+    assert status == "broken"
+    assert "promises a sparse selection" in findings[0].message
+
+
+@pytest.mark.fast
+def test_plan_verify_descriptor_per_regime():
+    from dgc_tpu.compression.planner import Plan
+
+    def desc(reg):
+        return Plan([reg], fabric="32x25GbE", world=8).verify_descriptor()
+
+    d = desc("fp32")
+    assert (d["gather_lanes"], d["eager_foldback"],
+            d["packed_words"]) == (2, False, False)
+    assert desc("int8") == {
+        "gather_lanes": 3, "conservation": "sparse",
+        "value_kinds": ("i8",), "packed_words": False,
+        "eager_foldback": True}
+    assert desc("int4_packed")["packed_words"] is True
+    assert desc("int8_delta_idx")["gather_lanes"] == 3
+    dd = desc("dense")
+    assert dd["conservation"] == "dense" and dd["gather_lanes"] == 0
+
+
+# --------------------------------------------------------------------- #
+# seeded mutations: the passes stay wired to the real engine             #
+# --------------------------------------------------------------------- #
+
+def _fixture_prog(mesh8):
+    from dgc_tpu.analysis.suite import build_fixture
+    state, step, _, (images, labels, key) = build_fixture(
+        mesh8, donate=False, telemetry=False)
+    return jxa.flatten(jax.make_jaxpr(step)(state, images, labels, key))
+
+
+def test_mutation_cast_bf16_turns_dtype_flow_red(mesh8, monkeypatch):
+    monkeypatch.setenv("DGC_VERIFY_MUTATE", "cast_bf16")
+    findings = check_dtype_flow(_fixture_prog(mesh8), REPO_ROOT)
+    assert findings, "seeded bf16 truncation not detected"
+    assert any(f.path.endswith("compression/flat.py") and f.line > 0
+               for f in findings)
+    assert "truncating cast" in findings[0].message
+
+
+def test_mutation_drop_foldback_turns_conservation_red(mesh8, monkeypatch):
+    monkeypatch.setenv("DGC_VERIFY_MUTATE", "drop_foldback")
+    status, findings = check_ef_conservation(_fixture_prog(mesh8),
+                                             REPO_ROOT)
+    assert status == "broken"
+    assert any("C3 broken" in f.message for f in findings)
+    assert any(f.path.endswith("compression/flat.py") and f.line > 0
+               for f in findings)
+
+
+def test_unmutated_fixture_is_conserving_and_clean(mesh8, monkeypatch):
+    monkeypatch.delenv("DGC_VERIFY_MUTATE", raising=False)
+    prog = _fixture_prog(mesh8)
+    assert check_dtype_flow(prog, REPO_ROOT) == []
+    status, findings = check_ef_conservation(prog, REPO_ROOT)
+    assert status == "ok" and findings == []
+
+
+# --------------------------------------------------------------------- #
+# the suite + waivers + regress gating                                   #
+# --------------------------------------------------------------------- #
+
+def test_verify_suite_green_on_all_pinned_configs(mesh8, tmp_path):
+    results = run_verify_suite(
+        mesh8, root=REPO_ROOT, fast=True, allowlist=load_allowlist())
+    bad = [(n, v) for n, v in results if v]
+    assert not bad, bad
+    # fast mode traces every config through the first three passes
+    names = {n.split("].")[0] + "]" for n, _ in results}
+    assert len(names) == len(verify.VERIFY_CONFIGS)
+
+
+@pytest.mark.fast
+def test_inline_dgcver_waiver_syntax():
+    line = "q = v.astype(jnp.int8)  # dgcver: ok[dtype-flow]"
+    assert Allowlist.inline_waiver(line, "dtype-flow", tool="dgcver")
+    assert not Allowlist.inline_waiver(line, "ef-conservation",
+                                       tool="dgcver")
+    # dgclint waivers do not leak into the dgcver namespace
+    assert not Allowlist.inline_waiver(
+        "x = 1  # dgclint: ok[dtype-flow]", "dtype-flow", tool="dgcver")
+
+
+@pytest.mark.fast
+def test_allowlist_matches_verify_findings():
+    from dgc_tpu.analysis.rules import Finding
+    al = Allowlist([{"rule": "ef-conservation", "file": "dgc_tpu/*",
+                     "reason": "test entry"}])
+    f = Finding(rule="ef-conservation", path="dgc_tpu/compression/flat.py",
+                line=1, col=0, snippet="x = 1", message="m")
+    assert al.match(f) == "test entry"
+    f2 = Finding(rule="dtype-flow", path="dgc_tpu/compression/flat.py",
+                 line=1, col=0, snippet="x = 1", message="m")
+    assert al.match(f2) is None
+
+
+@pytest.mark.fast
+def test_regress_gates_analysis_report(tmp_path):
+    from dgc_tpu.telemetry.regress import compare, load_summary
+    base = {"schema": "dgc-analysis-report-v1", "alias_coverage": 1.0,
+            "peak_live_bytes": 100000.0, "configs": {}}
+    worse = dict(base, alias_coverage=0.5, peak_live_bytes=250000.0)
+    pb, pn = tmp_path / "base.json", tmp_path / "new.json"
+    pb.write_text(json.dumps(base))
+    pn.write_text(json.dumps(worse))
+    rows = compare(load_summary(str(pb)), load_summary(str(pn)), tol=0.10)
+    by = {r["metric"]: r for r in rows}
+    assert by["alias_coverage"]["regressed"]        # higher is better
+    assert by["peak_live_bytes"]["regressed"]       # lower is better
+    # self-compare passes
+    rows = compare(load_summary(str(pb)), load_summary(str(pb)), tol=0.10)
+    assert not any(r["regressed"] for r in rows)
