@@ -50,3 +50,17 @@ configs.train.resilience.flight_steps = 256
 # drained losses — the run is unrecoverable past the guards' skip
 # horizon; 0 disables the breaker
 configs.train.resilience.nonfinite_streak = 3
+# cohort surgery (docs/RESILIENCE.md §"Cohort surgery"): fold the excise
+# order into the step-boundary agreement lane — the agree_preempt gather
+# widens to (preempt, verdict, target), grows a hang-safe deadline, and
+# an agreed excise takes the exit-76 survivors-only relaunch path
+configs.train.resilience.surgery = False
+# seconds a cohort member may trail the step boundary before the
+# agreement's deadline tier engages
+configs.train.resilience.boundary_timeout = 60.0
+# bounded extra waits on the in-flight agreement (exponential backoff:
+# total hang budget = timeout + backoff * (2^retries - 1)); past the
+# budget the agreement is declared lost -> exit 76, roll back to the
+# last atomic checkpoint
+configs.train.resilience.boundary_retries = 3
+configs.train.resilience.boundary_backoff = 5.0

@@ -41,6 +41,11 @@ tests exercise:
   the lowered HLO's collective counts — the all-dense plan compiles the
   sparse path away to zero gathers (the planner's never-lose fallback is
   structural, not a runtime branch).
+* **cohort surgery is host-only**: importing resilience/surgery leaves
+  the compiled step byte-identical to the plain build, and an ACTIVE
+  coordinator with a published excise order adds ZERO collectives — the
+  widened (preempt, verdict, target) agreement rides the existing
+  agree_preempt host gather, never the traced step.
 * **f32 end-to-end**: no f64 tensor type in any variant.
 * **trace stability**: same-shape calls never retrace.
 * **shard_state stays collective-free** (source contract): the
@@ -398,6 +403,40 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
                            "control/rules", "control/actions"],
         identical_to=plain)
     run(ctl.name, ctl.check)
+
+    # cohort surgery (ISSUE 15): order files, the widened boundary
+    # agreement, and the exit-76 spec arithmetic are all host-side —
+    # importing the module must leave the compiled step byte-identical
+    import dgc_tpu.resilience.surgery  # noqa: F401 — import must not leak
+    _, step_soff, _, _ = build_fixture(mesh, donate=False, telemetry=False)
+    soff = _step_contract(
+        "surgery-off-compiles-away", state, step_soff, inputs,
+        forbid_substrings=["resilience/surgery"],
+        identical_to=plain)
+    run(soff.name, soff.check)
+
+    # an ACTIVE coordinator with a published order still adds zero
+    # collectives to the step: the agreement rides the existing
+    # agree_preempt host gather at the boundary, never the traced step
+    def surgery_on():
+        import tempfile as _tf
+
+        from dgc_tpu.resilience import surgery as _surgery
+        with _tf.TemporaryDirectory() as d:
+            order = os.path.join(d, _surgery.ORDER_FILE)
+            _surgery.publish_order(order, "manual", 1)
+            coord = _surgery.SurgeryCoordinator(
+                order, process_index=0, process_count=1)
+            assert coord.agree(False).excise  # the host path is live
+            _, step_son, _, _ = build_fixture(
+                mesh, donate=False, telemetry=False)
+            son = _step_contract(
+                "surgery-on-no-new-collectives", state, step_son, inputs,
+                forbid_substrings=["resilience/surgery"],
+                collectives_delta=(plain, {"all-gather": 0,
+                                           "all-reduce": 0}))
+            return son.check()
+    run("surgery-on-no-new-collectives", surgery_on)
 
     # online replanning: an epoch-boundary refit whose plan key() is
     # unchanged must cost ZERO recompiles (the stable autotuned-<base>

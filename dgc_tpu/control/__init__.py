@@ -13,14 +13,19 @@ contract in :mod:`dgc_tpu.analysis.suite`). The pieces:
 * :mod:`dgc_tpu.control.rules` — declarative detector → remediation table
   with per-(run, rule) hit counting, debounce, and action budgets.
 * :mod:`dgc_tpu.control.actions` — the remediations themselves (restart,
-  elastic relaunch via the ``--env-file`` cohort republish, quarantine).
+  elastic relaunch via the ``--env-file`` cohort republish, quarantine,
+  and the cohort-surgery pair: excise / readmit).
 
 ``python -m dgc_tpu.control fleet.json`` runs a fleet from a spec file.
 """
 
 import os
 
-from dgc_tpu.control.plane import ControlPlane, RunSpec  # noqa: F401
+from dgc_tpu.control.plane import (  # noqa: F401
+    ControlPlane,
+    DevicePool,
+    RunSpec,
+)
 from dgc_tpu.control.rules import Rule, RuleEngine, default_rules  # noqa: F401
 from dgc_tpu.control.supervisor import (  # noqa: F401
     COHORT_KEYS,
@@ -30,9 +35,10 @@ from dgc_tpu.control.supervisor import (  # noqa: F401
     parse_env_file,
 )
 
-__all__ = ["COHORT_KEYS", "ControlPlane", "Rule", "RuleEngine", "RunSpec",
-           "Supervisor", "checkpoint_progress", "default_events_path",
-           "default_rules", "parse_env_file", "resolve_run_id"]
+__all__ = ["COHORT_KEYS", "ControlPlane", "DevicePool", "Rule",
+           "RuleEngine", "RunSpec", "Supervisor", "checkpoint_progress",
+           "default_events_path", "default_rules", "parse_env_file",
+           "resolve_run_id"]
 
 
 def resolve_run_id(default=None):

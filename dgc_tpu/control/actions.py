@@ -21,7 +21,7 @@ from dgc_tpu.control.supervisor import Supervisor, parse_env_file
 
 __all__ = ["publish_env", "default_cohort_planner", "act_restart",
            "act_elastic_relaunch", "act_quarantine", "act_adapt",
-           "ACTIONS", "execute"]
+           "act_excise", "act_readmit", "ACTIONS", "execute"]
 
 
 def publish_env(path: str, updates: Dict[str, str]) -> Dict[str, str]:
@@ -67,6 +67,16 @@ def default_cohort_planner(snap: Dict, evidence: Dict) -> Dict[str, str]:
         return {"JAX_NUM_PROCESSES": str(int(evidence["live_hosts"]))}
     if kind == "straggler" and procs > 1:
         return {"JAX_NUM_PROCESSES": str(procs - 1)}
+    if kind in ("hang", "desync", "flight_dump") and "worker" in evidence:
+        # excise: survivors-only world — prefer the evidence's recorded
+        # FROM-world (the plane's env-spec view) over stale telemetry
+        base = int(evidence.get("world") or procs)
+        if base > 1:
+            return {"JAX_NUM_PROCESSES": str(base - 1)}
+    if kind == "readmit":
+        tw = evidence.get("target_world")
+        return {"JAX_NUM_PROCESSES": str(int(tw))} if tw \
+            else {"JAX_NUM_PROCESSES": str(procs + 1)}
     return {}
 
 
@@ -123,12 +133,82 @@ def act_adapt(sup: Supervisor, evidence: Dict, **_kw) -> Dict:
     return result
 
 
+def act_excise(sup: Supervisor, evidence: Dict,
+               env_updates: Optional[Dict[str, str]] = None,
+               order_path: Optional[str] = None, **_kw) -> Dict:
+    """Cut ONE worker out of the cohort (docs/RESILIENCE.md §"Cohort
+    surgery"): publish the excise order next to the run's checkpoints —
+    the workers fold it into the step-boundary agreement lane and take
+    the exit-76 path — and publish the shrunk cohort spec the survivors
+    relaunch under. For a ``hang`` verdict the target is already
+    SIGKILLed; its supervisor is quarantined so the corpse is held for
+    the readmit probe instead of relaunching into a dead slot."""
+    from dgc_tpu.resilience import surgery as _surgery
+    result: Dict = {}
+    verdict = evidence.get("kind", "manual")
+    if verdict not in _surgery.VERDICTS or verdict == "none":
+        verdict = "manual"
+    target = evidence.get("worker")
+    if order_path is None and sup.watch:
+        order_path = os.path.join(sup.watch, _surgery.ORDER_FILE)
+    if order_path and target is not None:
+        _surgery.publish_order(order_path, verdict, int(target),
+                               extra={"rule_fired": evidence.get("hits")})
+        result["order"] = {"path": order_path, "verdict": verdict,
+                           "target": int(target)}
+    updates = dict(env_updates or {})
+    if updates and sup.env_file:
+        merged = publish_env(sup.env_file, updates)
+        result.update(env_file=sup.env_file, published=updates,
+                      cohort_spec={k: merged[k] for k in sorted(merged)})
+    else:
+        result["published"] = {}
+    if verdict == "hang":
+        already = sup.quarantined is not None
+        sup.quarantine(f"excised:{verdict}")
+        result.update(quarantined=sup.quarantined, already=already)
+    return result
+
+
+def act_readmit(sup: Supervisor, evidence: Dict,
+                env_updates: Optional[Dict[str, str]] = None,
+                relauncher=None, cohort_restart=None, **_kw) -> Dict:
+    """Deal a probe-passed quarantined worker back in: publish the grown
+    cohort spec, relaunch the worker under a fresh supervisor
+    (``relauncher`` — plane-provided), and restart the running cohort so
+    the grown spec takes effect at the next restart boundary
+    (``cohort_restart``). The elastic 1:k split reshard re-seats the
+    error-feedback state across the grown world at restore. Any stale
+    excise order / exit record is cleared first — the grown cohort must
+    not relaunch into last surgery's verdict."""
+    from dgc_tpu.resilience import surgery as _surgery
+    result: Dict = {}
+    if sup.watch:
+        _surgery.clear_order(os.path.join(sup.watch, _surgery.ORDER_FILE))
+        _surgery.clear_order(os.path.join(sup.watch,
+                                          _surgery.EXIT_RECORD))
+    updates = dict(env_updates or {})
+    if updates and sup.env_file:
+        merged = publish_env(sup.env_file, updates)
+        result.update(env_file=sup.env_file, published=updates,
+                      cohort_spec={k: merged[k] for k in sorted(merged)})
+    else:
+        result["published"] = {}
+    if relauncher is not None:
+        result["relaunched"] = bool(relauncher())
+    if cohort_restart is not None:
+        result["cohort_restarted"] = list(cohort_restart())
+    return result
+
+
 #: action name (registry.CONTROL_ACTIONS) -> implementation
 ACTIONS = {
     "restart": act_restart,
     "elastic_relaunch": act_elastic_relaunch,
     "quarantine": act_quarantine,
     "adapt": act_adapt,
+    "excise": act_excise,
+    "readmit": act_readmit,
 }
 
 

@@ -19,25 +19,40 @@ The tick loop closes the observe → decide → act cycle:
    through the run's supervisor and the result is appended to the audit
    stream with the triggering evidence attached.
 
-Quarantined runs are excluded from further rule evaluation: the plane
-stops reasoning about a run it has deliberately stopped healing.
+Quarantined runs are excluded from further rule evaluation — with ONE
+exception (docs/RESILIENCE.md §"Cohort surgery"): a quarantined run with
+a ``probe_cmd`` keeps being probed, and once the probe passes, the
+``readmit`` rule may fire on it. The :class:`DevicePool` ledger tracks
+where every run's device slots are (active → quarantined → freed →
+active), so capacity freed by quarantines flows back through readmits
+instead of leaking; the ledger is published as ``cohort.json`` under
+each run dir and the fleet root for the monitor's COHORT line and the
+``dgc_cohort_size`` / ``dgc_pool_free`` gauges.
 """
 
+import json
 import os
+import subprocess
+import tempfile
 import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 from dgc_tpu.control import actions as _actions
 from dgc_tpu.control.rules import Rule, RuleEngine
-from dgc_tpu.control.supervisor import Supervisor
+from dgc_tpu.control.supervisor import Supervisor, parse_env_file
 from dgc_tpu.telemetry import registry
 from dgc_tpu.telemetry.sink import JsonlAppender
 
-__all__ = ["RunSpec", "ControlPlane", "CONTROL_EVENTS"]
+__all__ = ["RunSpec", "DevicePool", "ControlPlane", "CONTROL_EVENTS",
+           "COHORT_FILE"]
 
 #: fleet-wide event stream file name under the fleet root
 CONTROL_EVENTS = "control_events.jsonl"
+
+#: ledger snapshot file name, written under each run dir and the fleet
+#: root every tick (the monitor's COHORT line reads it)
+COHORT_FILE = "cohort.json"
 
 
 class RunSpec(NamedTuple):
@@ -54,6 +69,59 @@ class RunSpec(NamedTuple):
     backoff: float = 5.0
     backoff_max: float = 300.0
     success_codes: Tuple[int, ...] = (0,)
+    #: re-init probe for readmission: exit 0 = the quarantined worker may
+    #: rejoin (clean init + checksum over a held-out batch; a
+    #: ``CHECKSUM:<hex>`` stdout line is recorded as probe evidence)
+    probe_cmd: Optional[Sequence[str]] = None
+    #: device slots this run holds in the :class:`DevicePool` ledger
+    slots: int = 1
+    #: supervisor-side hang escalation (SIGKILL past a stale heartbeat)
+    hang_timeout: Optional[float] = None
+    heartbeat: Optional[str] = None
+
+
+class DevicePool:
+    """Backpressure ledger: where each run's device slots are.
+
+    ``active`` — serving the run. ``quarantined`` — held with the
+    quarantined run for post-mortem (not schedulable). ``freed`` — the
+    readmit probe passed; capacity is back on the market and
+    ``dgc_pool_free`` counts it. A readmit moves the slots back to
+    ``active``. All transitions are one-way per call and idempotent, so
+    racing ticks cannot double-count a slot."""
+
+    def __init__(self, slots: Dict[str, int]):
+        self.slots = {n: int(c) for n, c in slots.items()}
+        self.state: Dict[str, str] = {n: "active" for n in self.slots}
+
+    def quarantine(self, name: str) -> None:
+        if self.state.get(name) == "active":
+            self.state[name] = "quarantined"
+
+    def release(self, name: str) -> None:
+        if self.state.get(name) == "quarantined":
+            self.state[name] = "freed"
+
+    def activate(self, name: str) -> None:
+        if name in self.state:
+            self.state[name] = "active"
+
+    def _count(self, want: str) -> int:
+        return sum(self.slots[n] for n, s in self.state.items()
+                   if s == want)
+
+    @property
+    def free(self) -> int:
+        return self._count("freed")
+
+    def snapshot(self) -> Dict:
+        return {"total": sum(self.slots.values()),
+                "active": self._count("active"),
+                "free": self.free,
+                "quarantined": sorted(n for n, s in self.state.items()
+                                      if s == "quarantined"),
+                "freed": sorted(n for n, s in self.state.items()
+                                if s == "freed")}
 
 
 class ControlPlane:
@@ -84,23 +152,28 @@ class ControlPlane:
         self._rcs: Dict[str, Optional[int]] = {}
         self.actions: List[Dict] = []   # the in-memory audit trail
         self._quarantine_audited: set = set()
+        self.pool = DevicePool({s.name: s.slots for s in specs})
+        self._probe: Dict[str, Dict] = {}   # run -> last probe result
         self.ticks = 0
         self._started = False
         self._sleep = threading.Event()
         for spec in specs:
             os.makedirs(spec.run_dir, exist_ok=True)
-            sup = Supervisor(
-                spec.cmd,
-                retries=spec.retries, backoff=spec.backoff,
-                backoff_max=spec.backoff_max, env_file=spec.env_file,
-                watch=spec.watch or os.path.join(spec.run_dir, "checkpoints"),
-                events=os.path.join(spec.run_dir, "supervise_events.jsonl"),
-                success_codes=spec.success_codes, name=spec.name,
-                extra_env=spec.env,
-                on_event=lambda rec, _n=spec.name: self._merge(_n, rec))
             self.specs[spec.name] = spec
-            self.supervisors[spec.name] = sup
+            self.supervisors[spec.name] = self._make_supervisor(spec)
             self._rcs[spec.name] = None
+
+    def _make_supervisor(self, spec: RunSpec) -> Supervisor:
+        return Supervisor(
+            spec.cmd,
+            retries=spec.retries, backoff=spec.backoff,
+            backoff_max=spec.backoff_max, env_file=spec.env_file,
+            watch=spec.watch or os.path.join(spec.run_dir, "checkpoints"),
+            events=os.path.join(spec.run_dir, "supervise_events.jsonl"),
+            success_codes=spec.success_codes, name=spec.name,
+            hang_timeout=spec.hang_timeout, heartbeat=spec.heartbeat,
+            extra_env=spec.env,
+            on_event=lambda rec, _n=spec.name: self._merge(_n, rec))
 
     # ------------------------------------------------------------------ #
     # event stream                                                       #
@@ -157,6 +230,111 @@ class ControlPlane:
         self._sleep.set()
 
     # ------------------------------------------------------------------ #
+    # cohort surgery machinery (docs/RESILIENCE.md §"Cohort surgery")    #
+    # ------------------------------------------------------------------ #
+
+    def _spec_world(self, name: str) -> Optional[int]:
+        """The published cohort-spec world for this run's env-file."""
+        spec = self.specs[name]
+        try:
+            w = parse_env_file(spec.env_file).get("JAX_NUM_PROCESSES")
+            return int(w) if w is not None else None
+        except (OSError, ValueError):
+            return None
+
+    def _run_probe(self, name: str) -> Dict:
+        """Re-init probe for a quarantined run: bounded subprocess; exit
+        0 passes, a ``CHECKSUM:<hex>`` stdout line rides the evidence.
+        Probed once per quarantine episode — a failing worker stays
+        quarantined (its slot never frees) until an operator intervenes."""
+        spec = self.specs[name]
+        result: Dict = {"t": time.time()}
+        try:
+            proc = subprocess.run(list(spec.probe_cmd), timeout=120.0,
+                                  capture_output=True, text=True)
+            result["rc"] = proc.returncode
+            result["passed"] = proc.returncode == 0
+            for line in (proc.stdout or "").splitlines():
+                if line.startswith("CHECKSUM:"):
+                    result["checksum"] = line.split(":", 1)[1].strip()
+        except (OSError, subprocess.TimeoutExpired) as e:
+            result.update(rc=None, passed=False, error=repr(e))
+        self._probe[name] = result
+        self._plane_event("probe", run=name, **result)
+        if result["passed"]:
+            self.pool.release(name)
+        return result
+
+    def _cohort_state(self, name: str) -> Dict:
+        """The ledger view injected into each snapshot (``snap["cohort"]``)
+        for the excise/readmit detectors and written to ``cohort.json``."""
+        state = dict(self.pool.snapshot())
+        state["pool_free"] = state.pop("free")
+        sw = self._spec_world(name)
+        if sw is not None:
+            state["spec_world"] = sw
+        probe = self._probe.get(name)
+        if probe is not None:
+            state["probe"] = dict(probe)
+        return state
+
+    def _relaunch(self, name: str) -> bool:
+        """Fresh supervisor + thread for a readmitted run (the old one
+        returned when it quarantined; a supervisor loop is one life)."""
+        old = self.supervisors.get(name)
+        if old is not None and old.state == "running":
+            return False
+        sup = self._make_supervisor(self.specs[name])
+        self.supervisors[name] = sup
+        self._rcs[name] = None
+        self._quarantine_audited.discard(name)
+        self._probe.pop(name, None)
+        self.pool.activate(name)
+        t = threading.Thread(target=self._supervise, args=(name, sup),
+                             name=f"dgc-control-{name}", daemon=True)
+        self._threads[name] = t
+        if self._started:
+            t.start()
+        return True
+
+    def _restart_cohort(self, readmitted: str) -> List[str]:
+        """SIGTERM the readmitted run's still-running cohort peers (the
+        runs sharing its env-file) so the grown spec takes effect at the
+        next restart boundary."""
+        env_file = self.specs[readmitted].env_file
+        restarted = []
+        for other, osup in self.supervisors.items():
+            if other == readmitted or osup.quarantined is not None:
+                continue
+            if self.specs[other].env_file != env_file:
+                continue
+            if osup.request_restart(reason="readmit"):
+                restarted.append(other)
+        return restarted
+
+    def _write_cohort_files(self) -> None:
+        """Atomic ``cohort.json`` under each run dir + the fleet root:
+        the monitor's COHORT line and the ``dgc_cohort_size`` /
+        ``dgc_pool_free`` gauges read these."""
+        per_run = {n: self._cohort_state(n) for n in self.specs}
+        fleet = dict(self.pool.snapshot(), t=time.time(),
+                     runs={n: self.pool.state.get(n) for n in self.specs})
+        for payload, path in (
+                [(dict(per_run[n], t=time.time()),
+                  os.path.join(self.specs[n].run_dir, COHORT_FILE))
+                 for n in self.specs]
+                + [(fleet, os.path.join(self.fleet_root, COHORT_FILE))]):
+            try:
+                d = os.path.dirname(path)
+                fd, tmp = tempfile.mkstemp(dir=d, prefix=".cohort.",
+                                           suffix=".tmp")
+                with os.fdopen(fd, "w") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, path)
+            except OSError:
+                pass    # a full disk must not stop the control loop
+
+    # ------------------------------------------------------------------ #
     # observe -> decide -> act                                           #
     # ------------------------------------------------------------------ #
 
@@ -167,20 +345,40 @@ class ControlPlane:
         self.ticks += 1
         fired: List[Dict] = []
         for name, sup in self.supervisors.items():
-            if sup.quarantined is not None:
-                # a self-quarantine (exit 70) still gets ONE audited pass
-                # so the evidence lands in the action trail; after that
-                # the plane stops reasoning about the run
-                if name in self._quarantine_audited:
+            quarantined = sup.quarantined is not None
+            if quarantined:
+                # ledger: a quarantined run holds its slots until the
+                # readmit probe frees them
+                self.pool.quarantine(name)
+                spec = self.specs[name]
+                if (spec.probe_cmd
+                        and self.pool.state.get(name) == "quarantined"
+                        and name not in self._probe):
+                    self._run_probe(name)
+            if quarantined and name in self._quarantine_audited:
+                # a self-quarantine still got its ONE audited pass; after
+                # that only the readmit path may keep reasoning about the
+                # run — capacity freed by its probe must flow back
+                if not (self._probe.get(name) or {}).get("passed"):
                     continue
             try:
                 snap = self._collect(self.specs[name].run_dir)
             except Exception:
                 continue    # young/torn/missing run: no evidence yet
+            snap = dict(snap, cohort=self._cohort_state(name))
             for rule, evidence in self.engine.evaluate(name, snap, now):
+                if (quarantined and name in self._quarantine_audited
+                        and rule.action != "readmit"):
+                    continue
                 kw = {}
-                if rule.action == "elastic_relaunch":
+                if rule.action in ("elastic_relaunch", "excise",
+                                   "readmit"):
                     kw["env_updates"] = self._planner(snap, evidence)
+                if rule.action == "readmit":
+                    kw["relauncher"] = \
+                        lambda _n=name: self._relaunch(_n)
+                    kw["cohort_restart"] = \
+                        lambda _n=name: self._restart_cohort(_n)
                 result = _actions.execute(rule.action, sup, evidence, **kw)
                 rec = {"event": "control_action", "run": name,
                        "run_id": sup.run_id, "rule": rule.name,
@@ -190,9 +388,14 @@ class ControlPlane:
                 self.stream.write(rec)
                 self.actions.append(rec)
                 fired.append(rec)
-                if rule.action == "quarantine":
-                    self._quarantine_audited.add(name)
-                    break   # no further reasoning about this run
+                if rule.action in ("quarantine", "excise"):
+                    if self.supervisors[name].quarantined is not None:
+                        self._quarantine_audited.add(name)
+                        self.pool.quarantine(name)
+                    break   # no further reasoning about this run now
+                if rule.action == "readmit":
+                    break   # the old supervisor object is gone
+        self._write_cohort_files()
         return fired
 
     def run(self, max_ticks: Optional[int] = None) -> Dict[str, Dict]:

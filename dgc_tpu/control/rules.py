@@ -32,7 +32,8 @@ from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 __all__ = ["Rule", "RuleEngine", "default_rules", "load_rules",
            "DETECTORS", "detect_desync", "detect_straggler",
-           "detect_quarantine", "detect_cohort_shrink"]
+           "detect_quarantine", "detect_cohort_shrink", "detect_excise",
+           "detect_readmit"]
 
 
 class Rule(NamedTuple):
@@ -126,6 +127,57 @@ def detect_cohort_shrink(snap: Dict) -> Optional[Dict]:
     return None
 
 
+def detect_excise(snap: Dict) -> Optional[Dict]:
+    """A worker was SIGKILLed by the supervisor's hang-escalation tier
+    (``hang_kill`` event, or the quarantine it left behind) — the
+    survivors are already taking the exit-76 path. Remediation:
+    ``excise`` — publish the order + shrunk cohort spec so the whole
+    fleet's record of the surgery is explicit and audited
+    (docs/RESILIENCE.md §"Cohort surgery")."""
+    last = snap.get("last_supervise") or {}
+    hang = last.get("event") == "hang_kill" or (
+        last.get("event") == "quarantined"
+        and str(last.get("reason", "")).startswith("hang:"))
+    if not hang:
+        return None
+    ev: Dict = {"kind": "hang", "reason": last.get("reason")}
+    cohort = last.get("cohort") or {}
+    try:
+        ev["worker"] = int(cohort.get("JAX_PROCESS_ID"))
+    except (TypeError, ValueError):
+        pass
+    # FROM-world: the spec the hung child LAUNCHED under (the event's
+    # cohort stamp) — by audit time the survivors' supervisors have
+    # already shrunk the live env-file, and deriving from that would
+    # shrink the cohort twice
+    try:
+        ev["world"] = int(cohort.get("JAX_NUM_PROCESSES"))
+    except (TypeError, ValueError):
+        plane_cohort = snap.get("cohort") or {}
+        if plane_cohort.get("spec_world"):
+            ev["world"] = int(plane_cohort["spec_world"])
+    return ev
+
+
+def detect_readmit(snap: Dict) -> Optional[Dict]:
+    """A quarantined worker passed its re-init probe and the device-pool
+    ledger holds freed capacity (``snap["cohort"]`` is the control
+    plane's injected ledger view). Remediation: ``readmit`` — publish
+    the grown cohort spec and relaunch the worker; the elastic 1:k
+    split reshard deals it back into the error-feedback state."""
+    cohort = snap.get("cohort") or {}
+    probe = cohort.get("probe") or {}
+    if not probe.get("passed") or not cohort.get("pool_free"):
+        return None
+    ev: Dict = {"kind": "readmit", "pool_free": int(cohort["pool_free"]),
+                "probe_rc": probe.get("rc")}
+    if probe.get("checksum"):
+        ev["checksum"] = probe["checksum"]
+    if cohort.get("spec_world"):
+        ev["target_world"] = int(cohort["spec_world"]) + 1
+    return ev
+
+
 def default_rules() -> Tuple[Rule, ...]:
     """The shipped remediation table (docs/TELEMETRY.md §"Control plane").
     Order matters: quarantine outranks everything — a numerically dead
@@ -139,6 +191,10 @@ def default_rules() -> Tuple[Rule, ...]:
              min_hits=3, debounce_s=120.0, budget=1),
         Rule("cohort-shrink-relaunch", detect_cohort_shrink,
              "elastic_relaunch", min_hits=2, debounce_s=120.0, budget=2),
+        Rule("hang-excise", detect_excise, "excise",
+             min_hits=1, debounce_s=60.0, budget=2),
+        Rule("probe-readmit", detect_readmit, "readmit",
+             min_hits=1, debounce_s=60.0, budget=2),
     )
 
 
@@ -148,6 +204,8 @@ DETECTORS: Dict[str, Callable[[Dict], Optional[Dict]]] = {
     "straggler": detect_straggler,
     "quarantine": detect_quarantine,
     "cohort_shrink": detect_cohort_shrink,
+    "excise": detect_excise,
+    "readmit": detect_readmit,
 }
 
 #: the Rule fields a ``rules.toml`` table may set

@@ -19,7 +19,13 @@ Mechanics (shared by CLI and control plane):
   EX_SOFTWARE — the nonfinite-streak abort in train.py) quarantines the
   run: no relaunch, artifacts kept for post-mortem. Exit code 75
   (EX_TEMPFAIL) is the convention for "preempted after a clean emergency
-  save — relaunch me"; anything else relaunches against the retry budget.
+  save — relaunch me"; a code in ``surgery_codes`` (default ``76``,
+  cohort surgery — docs/RESILIENCE.md §"Cohort surgery") applies the
+  workers' ``surgery_exit.json`` record (publish the shrunk cohort spec,
+  remap this survivor's ``JAX_PROCESS_ID`` around the excised slot, or
+  self-quarantine when THIS worker is the one cut out) and relaunches
+  immediately with the retry budget reset; anything else relaunches
+  against the retry budget.
 * retries are budgeted against *progress*: when ``watch`` names the
   checkpoint directory and its ``latest.json`` changed since the last
   launch (an emergency save counts), the failure counter resets.
@@ -40,6 +46,15 @@ from the control plane's thread:
   signal handler routes here).
 * ``quarantine(reason)`` — stop relaunching but keep artifacts; also
   entered automatically on a ``quarantine_codes`` exit.
+* ``request_kill()`` — SIGKILL the child (the watchdog escalation tier:
+  a SIGTERM assumes a responsive process; a hung one gets no courtesy).
+* ``hang_timeout``/``heartbeat`` — supervisor-side hang escalation: the
+  child's :class:`~dgc_tpu.resilience.preempt.Watchdog` refreshes the
+  heartbeat file's mtime each step (the path is exported to the child as
+  ``DGC_HEARTBEAT``); a monitor thread SIGKILLs + quarantines the child
+  once the mtime goes stale past ``hang_timeout`` seconds. The
+  survivors' blocked agreement collective then errors out and they take
+  the exit-76 surgery path.
 """
 
 import json
@@ -111,6 +126,7 @@ class Supervisor:
     def __init__(self, cmd, retries=5, backoff=5.0, backoff_max=300.0,
                  env_file=None, watch=None, events=None,
                  success_codes=(0,), quarantine_codes=(70,),
+                 surgery_codes=(76,), hang_timeout=None, heartbeat=None,
                  name=None, extra_env=None, on_event=None):
         self.cmd = list(cmd)
         self.retries = int(retries)
@@ -121,6 +137,13 @@ class Supervisor:
         self.events_path = events
         self.success_codes = set(success_codes)
         self.quarantine_codes = set(quarantine_codes or ())
+        self.surgery_codes = set(surgery_codes or ())
+        self.hang_timeout = (float(hang_timeout)
+                             if hang_timeout else None)
+        self.heartbeat = heartbeat
+        if self.hang_timeout and not self.heartbeat and watch:
+            self.heartbeat = os.path.join(
+                os.path.dirname(os.path.abspath(watch)), "heartbeat")
         self.name = name
         self.extra_env = dict(extra_env or {})
         self.on_event = on_event
@@ -129,6 +152,7 @@ class Supervisor:
         self.quarantined = None     # reason string once quarantined
         self.launches = 0
         self.last_rc = None
+        self._surgery_applied_t = None   # dedup: apply each record once
         self.state = "idle"         # running|done|stopped|gave_up|quarantined
         # one id per supervisor lifetime: every relaunch of this run
         # shares it, a fresh supervisor gets a fresh one
@@ -183,6 +207,17 @@ class Supervisor:
         self.event("restart_request", reason=reason, delivered=delivered)
         return delivered
 
+    def request_kill(self, reason="hang"):
+        """SIGKILL the child — the watchdog escalation tier for a hung
+        process (SIGTERM would route to a signal handler the process may
+        never service again). Quarantines the run first so the loop
+        holds the corpse for post-mortem instead of relaunching it."""
+        if self.quarantined is None:
+            self.quarantined = f"hang:{reason}"
+        delivered = self._signal_child(signal.SIGKILL)
+        self.event("hang_kill", reason=reason, delivered=delivered)
+        return delivered
+
     def request_stop(self, reason="signal"):
         """Stop relaunching and pass SIGTERM through so the child takes
         its emergency-save path (the CLI signal handler routes here)."""
@@ -204,6 +239,73 @@ class Supervisor:
         self.shutting_down = True
         self._signal_child(signum)
         self._wake.set()
+
+    # ------------------------------------------------------------------ #
+    # hang escalation + surgery (docs/RESILIENCE.md §"Cohort surgery")   #
+    # ------------------------------------------------------------------ #
+
+    def _watch_hang(self, child, launched_at):
+        """Monitor thread, one per launch: SIGKILL + quarantine the
+        child once the heartbeat file's mtime goes stale past
+        ``hang_timeout`` (startup counts from launch time, so a long
+        first compile needs a budget to match)."""
+        poll = max(0.05, min(1.0, self.hang_timeout / 4.0))
+        while child.poll() is None:
+            time.sleep(poll)
+            if child.poll() is not None or self.child is not child:
+                return
+            try:
+                last = os.path.getmtime(self.heartbeat)
+            except OSError:
+                last = None
+            ref = max(launched_at, last) if last is not None else launched_at
+            stale = time.time() - ref
+            if stale > self.hang_timeout:
+                self.request_kill(reason=f"no heartbeat for {stale:.1f}s "
+                                         f"(budget {self.hang_timeout}s)")
+                return
+
+    def _apply_surgery(self, rc):
+        """Exit-76 bookkeeping, applied once per exit record: publish
+        the shrunk cohort spec (idempotent — derived from the record's
+        FROM-world, so every survivor's supervisor computes the same
+        value and racing publishes agree), remap this run's
+        ``JAX_PROCESS_ID`` around the excised slot, and detect
+        self-excision (this run IS the target → quarantine, the cohort
+        spec no longer has a seat for it)."""
+        from dgc_tpu.resilience import surgery as _surgery
+        info = {}
+        rec = None
+        if self.watch:
+            rec = _surgery.read_exit_record(
+                os.path.join(self.watch, _surgery.EXIT_RECORD))
+        if not rec or rec.get("t") == self._surgery_applied_t:
+            return info
+        self._surgery_applied_t = rec.get("t")
+        target = int(rec.get("target", -1))
+        info.update(verdict=rec.get("verdict"), target=target,
+                    lost=bool(rec.get("lost")))
+        try:
+            world = int(rec.get("world") or 0)
+        except (TypeError, ValueError):
+            world = 0
+        updates = _surgery.shrink_updates(world, target)
+        if updates:
+            info["world"] = int(updates["JAX_NUM_PROCESSES"])
+            if self.env_file:
+                from dgc_tpu.control.actions import publish_env
+                publish_env(self.env_file, updates)
+                info["published"] = updates
+        pid = self.extra_env.get("JAX_PROCESS_ID",
+                                 os.environ.get("JAX_PROCESS_ID"))
+        if pid is not None and target >= 0:
+            new_pid = _surgery.remap_process_id(pid, target)
+            if new_pid is None:
+                info["excised"] = True
+            elif new_pid != int(pid):
+                self.extra_env["JAX_PROCESS_ID"] = str(new_pid)
+                info["process_id"] = new_pid
+        return info
 
     # ------------------------------------------------------------------ #
     # the loop                                                           #
@@ -233,6 +335,10 @@ class Supervisor:
             # world since the last launch) rides every event from here on
             self.cohort = {k: env.get(k) for k in COHORT_KEYS
                            if env.get(k) is not None}
+            if self.heartbeat:
+                # the child's Watchdog refreshes this file's mtime; the
+                # hang monitor below is its supervisor-side consumer
+                env["DGC_HEARTBEAT"] = self.heartbeat
             before = checkpoint_progress(self.watch)
             self.launches += 1
             self.event("launch", cmd=self.cmd,
@@ -240,6 +346,10 @@ class Supervisor:
                        env_overrides=sorted(overrides))
             t0 = time.time()
             self.child = subprocess.Popen(self.cmd, env=env)
+            if self.hang_timeout and self.heartbeat:
+                threading.Thread(target=self._watch_hang,
+                                 args=(self.child, t0),
+                                 name="dgc-hang-watch", daemon=True).start()
             rc = self.child.wait()
             self.child = None
             self.last_rc = rc
@@ -257,6 +367,19 @@ class Supervisor:
                 failures = 0
             else:
                 failures += 1
+            if (rc in self.surgery_codes and self.quarantined is None
+                    and not self.shutting_down):
+                info = self._apply_surgery(rc)
+                if info.pop("excised", False):
+                    # the shrunk spec has no seat for this worker: it is
+                    # the one being cut out — hold it for the readmit
+                    # probe instead of relaunching into a dead slot
+                    self.quarantined = \
+                        f"excised:{info.get('verdict') or rc}"
+                else:
+                    failures = 0    # a deliberate transition, not a crash
+                    self.event("surgery", rc=rc, elapsed=elapsed, **info)
+                    continue
             if rc in self.quarantine_codes and self.quarantined is None:
                 self.quarantined = f"exit:{rc}"
             if self.quarantined is not None:
@@ -322,6 +445,20 @@ def main(argv=None):
     parser.add_argument("--success-codes", default="0",
                         help="comma-separated child exit codes that end "
                              "the loop successfully")
+    parser.add_argument("--surgery-codes", default="76",
+                        help="comma-separated child exit codes treated "
+                             "as cohort surgery: apply surgery_exit.json "
+                             "(shrunk spec + process-id remap) and "
+                             "relaunch immediately (docs/RESILIENCE.md "
+                             "§\"Cohort surgery\"); empty disables")
+    parser.add_argument("--hang-timeout", type=float, default=None,
+                        help="SIGKILL + quarantine the child when its "
+                             "heartbeat file goes stale for this many "
+                             "seconds (the watchdog escalation tier)")
+    parser.add_argument("--heartbeat", default=None,
+                        help="heartbeat file path (exported to the child "
+                             "as DGC_HEARTBEAT; defaults to 'heartbeat' "
+                             "next to the --watch dir)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- then the training command")
     args = parser.parse_args(argv)
@@ -336,7 +473,10 @@ def main(argv=None):
         cmd, retries=args.retries, backoff=args.backoff,
         backoff_max=args.backoff_max, env_file=args.env_file,
         watch=args.watch, events=events,
-        success_codes={int(c) for c in args.success_codes.split(",")})
+        success_codes={int(c) for c in args.success_codes.split(",")},
+        surgery_codes={int(c) for c in args.surgery_codes.split(",")
+                       if c.strip()},
+        hang_timeout=args.hang_timeout, heartbeat=args.heartbeat)
     return sup.run()
 
 

@@ -26,6 +26,10 @@ pairs every guard with a deterministic injector that triggers it in tests:
   (down to a near-empty partial exchange past the deadline tier); the
   withheld mass stays in the error-feedback residual. Off compiles away
   byte-identically; on adds zero collectives (both contract-pinned).
+* :mod:`surgery` — worker-granular cohort surgery: excise-order files,
+  the widened hang-safe step-boundary agreement, exit-76 spec
+  arithmetic, and the readmit probe checksum (docs/RESILIENCE.md
+  §"Cohort surgery").
 """
 
 from dgc_tpu.resilience.guard import GuardConfig, init_state

@@ -29,6 +29,18 @@ Comma-separated tokens, each ``kind[@step][:key=val]*``:
   ``slow:ms=M@K-L``) arms the sleep only for steps K..L — the transient-
   straggler drill: the adaptive policy must engage inside the window and
   release after it.
+* ``hang[:secs=S]@K`` — host-side: the armed process stops dispatching at
+  step K WITHOUT exiting (the fault class ``kill`` cannot model — the
+  process is alive, so nothing reaps it, and a plain ``agree_preempt``
+  barrier deadlocks). Default is to block forever; ``secs=S`` bounds the
+  stall (a transient hang that resumes — the late-arrival leg of the
+  surgery agreement). Windowed like ``slow`` (``hang:secs=S@K-L`` stalls
+  each step in K..L). Drills the hang-safe agreement tier of
+  docs/RESILIENCE.md §"Cohort surgery".
+* ``exit:code=N@K`` — host-side ``os._exit(N)`` at step K: an arbitrary-
+  code crash, bypassing every handler and atexit hook (the messy death a
+  SIGTERM drill is too polite to model). Windowed like ``slow`` (fires
+  at the first step inside the window).
 
 With ``DGC_FAULTS`` unset every hook is an identity at trace time: zero
 ops, zero HLO difference (the guards-off compile-away contract runs with
@@ -41,8 +53,8 @@ import signal
 from typing import Dict, NamedTuple, Optional
 
 __all__ = ["FaultPlan", "plan", "armed", "inject_nan_grads", "corrupt_wire",
-           "corrupt_indices", "maybe_kill", "maybe_slow",
-           "should_fail_init"]
+           "corrupt_indices", "maybe_kill", "maybe_slow", "maybe_hang",
+           "maybe_exit", "should_fail_init"]
 
 ENV = "DGC_FAULTS"
 
@@ -56,6 +68,14 @@ class FaultPlan(NamedTuple):
     slow_ms: Optional[int] = None
     #: inclusive (first, last) step window for ``slow``; None = every step
     slow_window: Optional[tuple] = None
+    #: inclusive (first, last) step window for ``hang``; None = unarmed
+    hang_window: Optional[tuple] = None
+    #: per-step stall seconds for ``hang``; None = block forever
+    hang_secs: Optional[int] = None
+    #: ``os._exit`` code for ``exit``; None = unarmed
+    exit_code: Optional[int] = None
+    #: inclusive (first, last) step window for ``exit``
+    exit_window: Optional[tuple] = None
 
 
 def plan(spec: Optional[str] = None) -> FaultPlan:
@@ -63,8 +83,14 @@ def plan(spec: Optional[str] = None) -> FaultPlan:
     if spec is None:
         spec = os.environ.get(ENV, "")
     nan_step = kill_step = slow_ms = slow_window = None
+    hang_window = hang_secs = exit_code = exit_window = None
     init_failures = 0
     bitflip = badidx = None
+
+    def window(at):
+        lo, _, hi = at.partition("-")
+        return (int(lo), int(hi) if hi else None)
+
     for tok in filter(None, (t.strip() for t in spec.split(","))):
         parts = tok.split(":")
         head, _, at = parts[0].partition("@")
@@ -91,12 +117,18 @@ def plan(spec: Optional[str] = None) -> FaultPlan:
         elif head == "slow":
             slow_ms = params.get("ms", 100)
             if at:
-                lo, _, hi = at.partition("-")
-                slow_window = (int(lo), int(hi) if hi else None)
+                slow_window = window(at)
+        elif head == "hang":
+            hang_secs = params.get("secs")
+            hang_window = window(at) if at else (0, None)
+        elif head == "exit":
+            exit_code = params.get("code", 1)
+            exit_window = window(at) if at else (0, None)
         else:
             raise ValueError(f"unknown fault token {tok!r} in {ENV}")
     return FaultPlan(nan_step, kill_step, init_failures, bitflip, badidx,
-                     slow_ms, slow_window)
+                     slow_ms, slow_window, hang_window, hang_secs,
+                     exit_code, exit_window)
 
 
 def armed() -> bool:
@@ -191,6 +223,41 @@ def maybe_slow(step: Optional[int] = None) -> None:
             return
     import time
     time.sleep(p.slow_ms / 1000.0)
+
+
+def _in_window(step, win):
+    if win is None:
+        return False
+    lo, hi = win
+    if step is None or int(step) < lo:
+        return False
+    return hi is None or int(step) <= hi
+
+
+def maybe_hang(step: Optional[int] = None) -> None:
+    """Stop dispatching at the armed step WITHOUT exiting — the process
+    stays alive, so only the hang-safe agreement tier (deadline + the
+    supervisor's SIGKILL escalation, docs/RESILIENCE.md §"Cohort
+    surgery") can reap it. ``secs=S`` bounds the stall per step (the
+    transient-hang / late-arrival drill); the default blocks forever."""
+    p = plan()
+    if not _in_window(step, p.hang_window):
+        return
+    import time
+    if p.hang_secs is not None:
+        time.sleep(float(p.hang_secs))
+        return
+    while True:       # deliberately unreapable from inside: that is the fault
+        time.sleep(3600.0)
+
+
+def maybe_exit(step: Optional[int] = None) -> None:
+    """``os._exit(N)`` at the first armed step: an arbitrary-code crash
+    that bypasses handlers and atexit hooks (no emergency save, no clean
+    shutdown — the supervisor's retry budget is what catches this)."""
+    p = plan()
+    if p.exit_code is not None and _in_window(step, p.exit_window):
+        os._exit(int(p.exit_code))
 
 
 def should_fail_init(attempt: int) -> bool:

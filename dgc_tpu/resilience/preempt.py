@@ -19,7 +19,10 @@ rules. The contract with the hot loop is minimal:
   all-process verdict (one tiny gloo allgather) so a multi-process run
   enters the collective emergency save on the same step boundary
   everywhere. Cloud preemptions signal every worker; a test killing one
-  worker needs the agreement.
+  worker needs the agreement. ``resilience.surgery`` widens this same
+  lane to ``(preempt, verdict, target)`` for cohort surgery — still one
+  gather — and adds the hang-safe deadline ``agree_preempt`` itself
+  deliberately lacks.
 """
 
 import faulthandler
@@ -74,12 +77,20 @@ class Watchdog:
     ``flight``/``flight_path`` — optional telemetry.flight.FlightRecorder:
     a stall atomically dumps the recent-step ring to ``flight_path`` (the
     postmortem artifact; dump() never raises).
-    ``on_stall`` — optional callback for tests/custom handling."""
+    ``on_stall`` — optional callback for tests/custom handling.
+    ``heartbeat_path`` — optional file whose mtime ``beat()`` refreshes
+    (throttled to ~1 Hz): the supervisor-visible liveness signal behind
+    the hang-escalation tier of docs/RESILIENCE.md §"Cohort surgery".
+    The in-process watchdog stays diagnostics-only (dump stacks, flush,
+    rearm); KILLING a hung process is the supervisor's job, and a stale
+    heartbeat file is how it knows to (``Supervisor(hang_timeout=...)``
+    SIGKILLs the child once the mtime goes stale past the budget)."""
 
     def __init__(self, timeout: float, sink=None,
                  on_stall: Optional[Callable[[], None]] = None,
                  interval: Optional[float] = None, stream=None,
-                 flight=None, flight_path: Optional[str] = None):
+                 flight=None, flight_path: Optional[str] = None,
+                 heartbeat_path: Optional[str] = None):
         if timeout <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout}")
         self.timeout = timeout
@@ -91,14 +102,29 @@ class Watchdog:
         self._flight_path = flight_path
         self._interval = interval if interval is not None else max(
             0.1, timeout / 4.0)
+        self._heartbeat_path = heartbeat_path
+        self._hb_last = 0.0
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run,
                                         name="dgc-watchdog", daemon=True)
         self._thread.start()
+        if heartbeat_path:
+            self._write_heartbeat()     # supervisor sees life before step 1
 
     def beat(self):
         self._last = time.monotonic()
+        if self._heartbeat_path and (time.monotonic() - self._hb_last
+                                     >= 1.0):
+            self._write_heartbeat()
+
+    def _write_heartbeat(self):
+        try:
+            with open(self._heartbeat_path, "w") as f:
+                f.write(f"{time.time():.3f}\n")
+            self._hb_last = time.monotonic()
+        except OSError:
+            pass        # a full disk must not become a watchdog crash
 
     def _run(self):
         while not self._stop.wait(self._interval):

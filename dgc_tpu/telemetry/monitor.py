@@ -207,6 +207,18 @@ def collect(run: str, *, rate_window: int = 50) -> Dict:
             }
         except (OSError, ValueError):
             snap["flight"] = {"reason": "unreadable", "path": fpath}
+    # cohort surgery state published by the control plane (docs/
+    # RESILIENCE.md §"Cohort surgery") — tolerant: absent or torn file
+    # just means no COHORT line / gauges
+    cpath = os.path.join(base, "cohort.json")
+    if os.path.isfile(cpath):
+        try:
+            with open(cpath) as f:
+                cohort = json.load(f)
+            if isinstance(cohort, dict):
+                snap["cohort"] = cohort
+        except (OSError, ValueError):
+            pass
     sup = read_supervise_events(run)
     if sup:
         snap["supervise_launches"] = max(
@@ -302,6 +314,19 @@ def _snap_samples(snap: Dict, families: Dict) -> None:
         gauge("dgc_supervise_launches",
               "trainer launches recorded by the restart supervisor",
               [(_labels(run), snap["supervise_launches"])])
+    cohort = snap.get("cohort")
+    if isinstance(cohort, dict):
+        size = cohort.get("target") or cohort.get("spec_world")
+        if isinstance(size, (int, float)):
+            gauge("dgc_cohort_size",
+                  "published cohort spec world size (surgery target)",
+                  [(_labels(run), size)])
+        free = cohort.get("pool_free")
+        if isinstance(free, (int, float)):
+            gauge("dgc_pool_free",
+                  "device-pool slots freed by readmit probes and "
+                  "available for cohort growth",
+                  [(_labels(run), free)])
 
 
 def _render_families(families: Dict) -> str:
@@ -440,6 +465,28 @@ def render_status(snap: Dict) -> str:
             f"{first.get('band', 0.0):.2f})")
     else:
         lines.append("   desync: quiet")
+
+    cohort = snap.get("cohort")
+    if isinstance(cohort, dict):
+        target = cohort.get("target") or cohort.get("spec_world")
+        active = cohort.get("active")
+        parts = []
+        if target is not None:
+            parts.append(f"world {active if active is not None else '?'}"
+                         f"/{target}")
+        q = cohort.get("quarantined") or []
+        if q:
+            parts.append("quarantined=[" + ",".join(str(n) for n in q)
+                         + "]")
+        free = cohort.get("pool_free", cohort.get("free"))
+        if free is not None:
+            parts.append(f"pool free {free}")
+        probe = cohort.get("probe")
+        if isinstance(probe, dict):
+            parts.append("probe "
+                         + ("passed" if probe.get("passed") else "failed"))
+        if parts:
+            lines.append("   COHORT: " + "  ".join(parts))
 
     if "last_event" in snap:
         lines.append("   last run event:   "

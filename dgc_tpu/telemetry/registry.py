@@ -152,6 +152,17 @@ CONTROL_ACTIONS: Tuple[MetricSpec, ...] = (
                "and restart so the relaunch runs with the straggler-"
                "adaptive exchange engaged (resilience.adaptive) — the "
                "persistent-straggler soft remediation", better="lower"),
+    MetricSpec("excise", "action",
+               "cut one worker out of the cohort: publish the excise order "
+               "(resilience.surgery) so the step-boundary agreement spreads "
+               "the verdict, publish the shrunk cohort spec, and let the "
+               "survivors take the exit-76 / elastic-reshard relaunch — the "
+               "hang / per-worker-fault hard remediation", better="lower"),
+    MetricSpec("readmit", "action",
+               "deal a probe-passed quarantined worker back in: publish the "
+               "grown cohort spec and relaunch it; the elastic 1:k split "
+               "reshard re-seats the error-feedback state — frees the "
+               "device-pool ledger's quarantine slot", better="lower"),
 )
 
 #: run-level summary keys the regression gate compares (step time and

@@ -69,6 +69,18 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_control.py \
   -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "CONTROL_SMOKE=ok" || { echo "CONTROL_SMOKE=FAIL"; rc=1; }
+# surgery smoke (docs/RESILIENCE.md §"Cohort surgery"): fault-plan
+# hang/exit tokens, the order/exit-record file protocol, the widened
+# (preempt, verdict, target) agreement lane with its hang-safe deadline
+# tier, the supervisor's exit-76 + heartbeat hang escalation, the
+# device-pool ledger — and the 3-process excise/readmit drill: worker 2
+# hangs at step 5, its supervisor SIGKILLs it, survivors exit 76 with an
+# atomic emergency checkpoint and relaunch as W=2 under the published
+# shrunk spec, the re-init probe frees the slot, and a rule-driven
+# readmit grows the cohort back to W=3 — every transition audited
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_surgery.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "SURGERY_SMOKE=ok" || { echo "SURGERY_SMOKE=FAIL"; rc=1; }
 # adaptive smoke (docs/RESILIENCE.md §Adaptive exchange): policy units,
 # the engine-level masked exchange vs the NumPy mass-conservation oracle,
 # checkpoint strip/re-seed (incl. the elastic world-change resume), the

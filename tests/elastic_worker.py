@@ -216,19 +216,47 @@ def main():
                 denom = max(abs(a), abs(b), 1e-6)
                 mass_rel = max(mass_rel, abs(a - b) / denom)
         assert mass_rel < 1e-5, f"gradient mass not conserved: {mass_rel}"
-        # BN stats: each child row is the mean of its parent group
-        k = from_world // W
-        for pth, leaf in jax.tree_util.tree_flatten_with_path(
-                raw[0].batch_stats)[0]:
-            new_leaf = r_state.batch_stats
-            for key in pth:
-                new_leaf = new_leaf[key.key]
-            old = np.asarray(jax.device_get(leaf), np.float64)
-            new = np.asarray(jax.device_get(new_leaf), np.float64)
-            for c in range(W):
-                np.testing.assert_allclose(
-                    new[c], old[c * k:(c + 1) * k].mean(axis=0),
-                    rtol=1e-5, atol=1e-6)
+        if W > from_world:
+            # grow (1:k split): child c%k==0 inherits parent c//k
+            # BITWISE (sent_bits included); siblings start zeroed —
+            # their residual mass is zero and their keep mask is all-keep
+            k = W // from_world
+            raw_mem = host_memory(raw[0].memory)
+            new_mem = host_memory(r_state.memory)
+            for mkey, new_rows in new_mem.items():
+                old_rows = raw_mem[mkey]
+                for c in range(W):
+                    if c % k == 0:
+                        np.testing.assert_array_equal(
+                            new_rows[c], old_rows[c // k],
+                            err_msg=f"{mkey}[{c}] not bitwise-inherited")
+                    else:
+                        assert not np.any(new_rows[c]), \
+                            f"{mkey}[{c}] sibling not zeroed"
+            # BN stats: every child copies its parent's row exactly
+            for pth, leaf in jax.tree_util.tree_flatten_with_path(
+                    raw[0].batch_stats)[0]:
+                new_leaf = r_state.batch_stats
+                for key in pth:
+                    new_leaf = new_leaf[key.key]
+                old = np.asarray(jax.device_get(leaf), np.float64)
+                new = np.asarray(jax.device_get(new_leaf), np.float64)
+                for c in range(W):
+                    np.testing.assert_array_equal(new[c], old[c // k])
+        else:
+            # BN stats: each child row is the mean of its parent group
+            k = from_world // W
+            for pth, leaf in jax.tree_util.tree_flatten_with_path(
+                    raw[0].batch_stats)[0]:
+                new_leaf = r_state.batch_stats
+                for key in pth:
+                    new_leaf = new_leaf[key.key]
+                old = np.asarray(jax.device_get(leaf), np.float64)
+                new = np.asarray(jax.device_get(new_leaf), np.float64)
+                for c in range(W):
+                    np.testing.assert_allclose(
+                        new[c], old[c * k:(c + 1) * k].mean(axis=0),
+                        rtol=1e-5, atol=1e-6)
         r_state = shard_state(jax.tree.map(jnp.asarray, r_state), mesh,
                               dist_opt=dist)
         r_state, losses = train_range(r_state, SAVE_STEPS, TOTAL_STEPS)

@@ -1,0 +1,198 @@
+"""Fake cohort member for the surgery drill (tests/test_surgery.py).
+
+Three of these form a W=3 cohort under one ControlPlane, lock-stepped
+through a file barrier in a shared ``--cohort`` dir — no jax, no real
+collective, millisecond steps — so the full excise/readmit cycle of
+docs/RESILIENCE.md §"Cohort surgery" runs in seconds:
+
+* every step: touch the supervisor's heartbeat (``DGC_HEARTBEAT``), run
+  the REAL fault plan (``DGC_FAULTS=hang@5-5`` stalls exactly like
+  train.py's injector), then write a barrier marker and wait for all
+  ``JAX_NUM_PROCESSES`` peers' markers;
+* a peer that never reaches the barrier (hung → SIGKILLed by its
+  supervisor) times the barrier out: the survivors take the exit-76
+  path — one atomic ``latest.json`` save (the drill's stand-in for the
+  emergency checkpoint), a ``surgery_exit.json`` record naming the
+  missing member, ``os._exit(76)``;
+* progress is shared (``progress.json`` in the cohort dir) and barrier
+  markers persist, so a relaunch under a re-published spec — survivors
+  at W=2, the readmitted worker back at W=3 — resumes at the cohort's
+  step and fast-forwards through markers already on disk;
+* SIGTERM (the readmit cohort restart) takes the emergency-save path:
+  bump ``latest.json``, exit 75;
+* ``--probe`` is the re-init probe: deterministic checksum over a
+  held-out array, ``CHECKSUM:<hex>`` on stdout, exit 0.
+
+Telemetry is the fleet schema (like tests/control_worker.py) so the
+plane's monitor.collect sees a real-looking run every tick.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.resilience import faults, surgery  # noqa: E402
+from dgc_tpu.telemetry import registry  # noqa: E402
+
+
+def _atomic_json(path, payload):
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _read_step(path, default=0):
+    try:
+        with open(path) as f:
+            return int(json.load(f).get("step", default))
+    except (OSError, ValueError):
+        return default
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run_dir")
+    ap.add_argument("--cohort", required=True,
+                    help="shared dir: barrier markers + progress.json")
+    ap.add_argument("--steps", type=int, default=140)
+    ap.add_argument("--step-ms", type=float, default=30.0)
+    ap.add_argument("--world", type=int, default=3,
+                    help="telemetry lane width (fixed across phases)")
+    ap.add_argument("--probe", action="store_true",
+                    help="re-init probe mode: print CHECKSUM:<hex>, exit 0")
+    args = ap.parse_args(argv)
+
+    if args.probe:
+        import numpy as np
+        arr = np.arange(256, dtype=np.float32)
+        print("CHECKSUM:" + surgery.probe_checksum([arr]), flush=True)
+        return 0
+
+    run_dir = os.path.abspath(args.run_dir)
+    ckpt_dir = os.path.join(run_dir, "checkpoints")
+    cohort_dir = os.path.abspath(args.cohort)
+    bar_dir = os.path.join(cohort_dir, "barriers")
+    for d in (ckpt_dir, bar_dir):
+        os.makedirs(d, exist_ok=True)
+    shard_dir = os.path.join(run_dir, "telemetry", "host0")
+    os.makedirs(shard_dir, exist_ok=True)
+
+    W = int(os.environ.get("JAX_NUM_PROCESSES") or 1)
+    pid = int(os.environ.get("JAX_PROCESS_ID") or 0)
+    hb_path = os.environ.get("DGC_HEARTBEAT")
+    boundary_timeout = float(os.environ.get("DGC_BOUNDARY_TIMEOUT") or 10.0)
+    progress_path = os.path.join(cohort_dir, "progress.json")
+
+    static = {"world": args.world, "num_params": 1000, "payload_elems": 50,
+              "num_processes": W, "process_id": pid}
+    run_id = os.environ.get("DGC_RUN_ID")
+    if run_id:
+        static["run_id"] = run_id
+
+    def beat():
+        if not hb_path:
+            return
+        try:
+            with open(hb_path, "a"):
+                pass
+            os.utime(hb_path, None)
+        except OSError:
+            pass
+
+    def save(completed):
+        _atomic_json(os.path.join(ckpt_dir, "latest.json"),
+                     {"epoch": int(completed)})
+
+    fh = open(os.path.join(shard_dir, "telemetry.jsonl"), "w")
+
+    def emit(rec):
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+
+    emit(registry.make_header(static, guards=True, fleet=True))
+
+    # cohort-wide resume point: all members of a (re)formed cohort start
+    # at the same shared step, whatever their own run lived through
+    step = max(_read_step(progress_path),
+               _read_step(os.path.join(ckpt_dir, "latest.json"), 0))
+    state = {"step": step}
+
+    def on_term(signum, frame):
+        # emergency-save path: visible progress, exit 75 so the
+        # supervisor relaunches under the currently published spec
+        save(state["step"])
+        fh.flush()
+        os._exit(75)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    def barrier(s):
+        """Write own marker, wait for all W peers'. Markers persist, so
+        a resuming member fast-forwards through past steps. Returns the
+        missing member ids on deadline (the hang signature)."""
+        own = os.path.join(bar_dir, "b%d.%d" % (s, pid))
+        with open(own, "w") as f:
+            f.write(str(time.time()))
+        deadline = time.time() + boundary_timeout
+        while True:
+            missing = [q for q in range(W)
+                       if not os.path.exists(
+                           os.path.join(bar_dir, "b%d.%d" % (s, q)))]
+            if not missing:
+                return []
+            beat()      # a member BLOCKED at the boundary is not hung
+            if time.time() > deadline:
+                return missing
+            time.sleep(0.015)
+
+    while state["step"] < args.steps:
+        s = state["step"]
+        beat()
+        faults.maybe_hang(s)        # the real injector train.py uses
+        faults.maybe_exit(s)
+        missing = barrier(s)
+        if missing:
+            # cohort lost at the step boundary: atomic emergency save,
+            # exit record naming the missing member, exit 76 — the
+            # supervisor applies the record and relaunches survivors
+            # under the shrunk published spec
+            save(s)
+            ag = surgery.Agreement(excise=True, target=max(missing),
+                                   verdict="hang", lost=True)
+            surgery.write_exit_record(
+                os.path.join(ckpt_dir, surgery.EXIT_RECORD), ag,
+                world=W, process_index=pid, step=s)
+            emit({"event": "surgery_exit", "t_host": round(time.time(), 3),
+                  "step": s, "missing": missing})
+            fh.flush()
+            os._exit(surgery.EXIT_SURGERY)
+        time.sleep(args.step_ms / 1000.0)
+        state["step"] = s + 1
+        save(s + 1)
+        _atomic_json(progress_path, {"step": s + 1})
+        emit({
+            "step": s, "t_host": round(time.time(), 3),
+            "loss": round(2.0 - 0.01 * s, 4),
+            "grad_norm": 1.0, "payload_elems": 50.0,
+            "w_clock": [10.0] * args.world,
+            "w_grad_norm": [1.0] * args.world,
+            "w_residual_mass": [100.0] * args.world,
+            "w_sent_ratio": [0.05] * args.world,
+            "straggler": 0.0, "straggler_gap": 0.0, "worker_skew": 0.1,
+        })
+
+    emit({"event": "run_done", "t_host": round(time.time(), 3),
+          "steps": args.steps, "world": W})
+    fh.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

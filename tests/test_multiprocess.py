@@ -221,6 +221,32 @@ def test_elastic_cross_topology_resume(tmp_path):
     assert last6 < first6
 
 
+def test_elastic_grow_resume(tmp_path):
+    """Elastic GROW drill (docs/RESILIENCE.md §"Cohort surgery" readmit
+    path): save at W=1, resume at W=2 — the 1:k split. The worker
+    asserts the split semantics directly on the restored arrays: child
+    c%k==0 inherits its parent's rows BITWISE (sent_bits included),
+    siblings start zeroed, BN rows are copied; here we re-pin that the
+    per-parameter residual+momentum gradient mass recovered from disk
+    equals what the save phase computed from the live state — growth
+    must conserve mass exactly, not just shrinkage."""
+    save = _run_elastic_phase(tmp_path, "save", 1)
+    res = _run_elastic_phase(tmp_path, "resume", 2, 1)
+    assert res["start"] == 10
+    assert res["mass_rel"] < 1e-5
+    for name, (m_saved, v_saved) in save["mass"].items():
+        m_new, v_new = res["mass"][name]
+        for a, b in ((m_saved, m_new), (v_saved, v_new)):
+            assert abs(a - b) <= 1e-5 * max(abs(a), abs(b), 1e-6), \
+                f"{name}: {a} vs {b}"
+    losses = res["losses"]
+    assert all(l == l and abs(l) < 1e6 for l in losses)
+    # the grown run keeps learning on the same global-batch schedule
+    assert losses[-1] < max(1.5 * save["losses"][-1],
+                            0.35 * save["losses"][0]), \
+        f"grown run diverged: {losses}"
+
+
 def test_fleet_two_process_straggler(tmp_path):
     """Fleet observability drill (docs/TELEMETRY.md §Fleet monitoring):
     run the fleet train step across 2 real processes with

@@ -21,6 +21,7 @@ TPU-native differences by design:
 import argparse
 import itertools
 import os
+import sys
 import time
 
 import numpy as np
@@ -575,7 +576,7 @@ def main():
     # save at a step boundary); watchdog dumps stacks on a stalled step;
     # the flight recorder keeps a ring of recent step records for the
     # postmortem dump (watchdog stall / preemption / nonfinite streak)
-    handler = watchdog = None
+    handler = watchdog = surgeon = None
     flight = flight_path = streak = None
     if res_on:
         from dgc_tpu.resilience import faults as _faults
@@ -601,11 +602,27 @@ def main():
             streak = NonfiniteStreak(ns)
         wd_secs = float(rcfg.get("watchdog_secs", 0) or 0)
         if wd_secs > 0:
-            watchdog = _preempt.Watchdog(wd_secs, sink=sink, flight=flight,
-                                         flight_path=flight_path)
+            # tier-1 hang escalation: in-process diagnostics; the
+            # heartbeat file (DGC_HEARTBEAT, supervisor-provided) is the
+            # tier-2 signal — a stale mtime tells the supervisor to
+            # SIGKILL us (docs/RESILIENCE.md §"Cohort surgery")
+            watchdog = _preempt.Watchdog(
+                wd_secs, sink=sink, flight=flight,
+                flight_path=flight_path,
+                heartbeat_path=os.environ.get("DGC_HEARTBEAT"))
+        if bool(rcfg.get("surgery", False)):
+            from dgc_tpu.resilience import surgery as _surgery
+            surgeon = _surgery.SurgeryCoordinator(
+                os.path.join(ckpt_dir, _surgery.ORDER_FILE),
+                boundary_timeout=float(
+                    rcfg.get("boundary_timeout", 60.0)),
+                retries=int(rcfg.get("boundary_retries", 3)),
+                backoff=float(rcfg.get("boundary_backoff", 5.0)),
+                log=lambda m: printr(f"[surgery] {m}"))
         printr(f"[resilience] guards={guards_cfg} checksum={res_checksum} "
                f"watchdog={wd_secs or 'off'} "
-               f"flight={fl_steps or 'off'}")
+               f"flight={fl_steps or 'off'} "
+               f"surgery={'on' if surgeon is not None else 'off'}")
 
     ############
     # Training #
@@ -622,6 +639,7 @@ def main():
     gstep = (last_epoch + 1) * steps_per_epoch + resume_batch
     preempted = False
     preempt_at = -1
+    surgery_exit = None      # the agreed excise Agreement, if any
     aborted = False          # nonfinite-streak breaker tripped
     last_ckpt_epoch = last_epoch
     for epoch in range(last_epoch + 1, configs.train.num_epochs):
@@ -706,8 +724,37 @@ def main():
                 # preemption check at the step boundary: agree_preempt is
                 # a (tiny, host-side) collective on multi-process runs, so
                 # every process takes the emergency-save path on the SAME
-                # step — a lone worker breaking out would hang the rest
-                if handler is not None and _preempt.agree_preempt(
+                # step — a lone worker breaking out would hang the rest.
+                # With surgery on, the same gather widens to (preempt,
+                # verdict, target) and grows a hang-safe deadline.
+                if handler is not None and surgeon is not None:
+                    ag = surgeon.agree(handler.requested)
+                    if ag.lost:
+                        # a member is hung/dead mid-gather: no further
+                        # collective (emergency save included) can
+                        # complete. Dump the flight ring, leave the
+                        # exit-76 breadcrumb, and go down hard — recovery
+                        # rolls back to the last atomic checkpoint (the
+                        # dead worker's post-checkpoint residual is
+                        # unrecoverable regardless; docs/RESILIENCE.md
+                        # §"Cohort surgery")
+                        if flight is not None:
+                            flight.dump(flight_path,
+                                        reason="surgery: cohort lost")
+                        _surgery.write_exit_record(
+                            os.path.join(ckpt_dir, _surgery.EXIT_RECORD),
+                            ag, world=jax.process_count(),
+                            process_index=jax.process_index(), step=gstep)
+                        printr("[surgery] cohort lost at the boundary — "
+                               f"exit {_surgery.EXIT_SURGERY} "
+                               "(roll back to the last checkpoint)")
+                        sys.stdout.flush()
+                        os._exit(_surgery.EXIT_SURGERY)
+                    if ag.excise or ag.preempt:
+                        surgery_exit = ag if ag.excise else None
+                        preempted, preempt_at = True, bidx - 1
+                        break
+                elif handler is not None and _preempt.agree_preempt(
                         handler.requested):
                     preempted, preempt_at = True, bidx - 1
                     break
@@ -774,6 +821,8 @@ def main():
                 if watchdog is not None:
                     watchdog.beat()
                 if res_on and _faults.armed():
+                    _faults.maybe_hang(gstep)
+                    _faults.maybe_exit(gstep)
                     _faults.maybe_kill(gstep)
                 if sink is not None and bidx % telem_every == 0:
                     # device arrays enqueued as-is: the sink's drain
@@ -885,11 +934,19 @@ def main():
         # the in-progress epoch and last completed batch, so resume picks
         # up at the exact next batch. All processes reach here on the same
         # step (agree_preempt), so the collective save lines up.
-        printr(f"\n[preempt] signal {handler.signum}: stopping at "
-               f"epoch {epoch}, batch {preempt_at}")
+        if surgery_exit is not None:
+            printr(f"\n[surgery] excise agreed: verdict="
+                   f"{surgery_exit.verdict} target={surgery_exit.target}"
+                   f" — stopping at epoch {epoch}, batch {preempt_at}")
+        else:
+            printr(f"\n[preempt] signal {handler.signum}: stopping at "
+                   f"epoch {epoch}, batch {preempt_at}")
         if flight is not None:
-            p = flight.dump(flight_path,
-                            reason=f"preempt signal {handler.signum}")
+            reason = (f"surgery: excise {surgery_exit.verdict} "
+                      f"worker {surgery_exit.target}"
+                      if surgery_exit is not None
+                      else f"preempt signal {handler.signum}")
+            p = flight.dump(flight_path, reason=reason)
             if p:
                 printr(f"[preempt] flight recorder -> {p}")
         if bool(rcfg.get("emergency_checkpoint", True)):
@@ -902,6 +959,16 @@ def main():
             path = _preempt.emergency_save(ckpt, epoch, state, emeters,
                                            topology=topology)
             printr(f"[preempt] emergency checkpoint -> {path}")
+        if surgery_exit is not None:
+            # orderly excise: everyone was alive at the boundary, so the
+            # collective emergency save above is complete — leave the
+            # exit-76 breadcrumb for the supervisors and retire the
+            # consumed order (a relaunched cohort must not re-excise)
+            _surgery.write_exit_record(
+                os.path.join(ckpt_dir, _surgery.EXIT_RECORD),
+                surgery_exit, world=jax.process_count(),
+                process_index=jax.process_index(), step=gstep)
+            _surgery.clear_order(surgeon.order_path)
 
     if trace_on:
         tpath = tracer.save(
@@ -923,6 +990,11 @@ def main():
         raise SystemExit(70)
     if preempted:
         _preempt.clean_shutdown()
+        if surgery_exit is not None:
+            # cohort surgery: the supervisor maps 76 to a survivors-only
+            # relaunch under the published shrunk cohort spec (the PR-5
+            # elastic reshard absorbs the excised worker's mass)
+            raise SystemExit(76)
         # EX_TEMPFAIL: tell a supervisor (scripts/supervise.py) this was
         # a clean preemption with the emergency save already on disk —
         # relaunch (a plain 0 would read as "training finished")
