@@ -236,10 +236,54 @@ def main():
     fused_select = os.environ.get("DGC_FUSED_SELECT", "") == "1"
     if fused_select:
         print("fused select/pack: ON", file=sys.stderr)
+    # DGC_MEGAKERNEL=1 collapses the whole per-bucket hot path into the
+    # two streamed Pallas megakernels (kernels.dgc_forward_rows /
+    # dgc_apply_rows) — subsumes both fused flags on eligible buckets
+    megakernel = os.environ.get("DGC_MEGAKERNEL", "") == "1"
+    if megakernel:
+        print("two-megakernel hot path: ON", file=sys.stderr)
     comp = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
                          fused_apply=fused_apply,
-                         fused_select=fused_select)
+                         fused_select=fused_select,
+                         megakernel=megakernel)
     comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+
+    if os.environ.get("DGC_MEGAKERNEL_AB", "") == "1":
+        # megakernel A/B: dgc+megakernel vs plain dgc, SAME paired
+        # interleaved methodology as the headline run — both arms are the
+        # identical flat engine, so the paired median isolates the
+        # launch/stream savings of the fused hot path. Negative medians
+        # mean the megakernel build is faster; regress.py gates
+        # overhead_ms_megakernel lower-is-better against this artifact.
+        def mk_dist(mk):
+            c = DGCCompressor(0.001, memory=DGCSGDMemory(momentum=0.9),
+                              megakernel=mk)
+            c.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+            return DistributedOptimizer(
+                dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4), c,
+                world_size=W)
+        mk_run, _ = prepare(mk_dist(True))
+        plain_run, _ = prepare(mk_dist(False))
+        rows = _interleaved_step_ms([mk_run, plain_run], rtt)
+        mk_ms, plain_ms = (min(col) for col in zip(*rows))
+        diffs = [a - b for a, b in rows]
+        delta = statistics.median(diffs)
+        q1, q3 = (float(x) for x in np.percentile(diffs, [25, 75]))
+        print(f"megakernel step {mk_ms:.4f} ms | plain step "
+              f"{plain_ms:.4f} ms | paired median delta {delta:.4f} ms "
+              f"({100 * delta / max(plain_ms, 1e-9):.2f}%)",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": "overhead_ms_megakernel_resnet20_dgc0.001",
+            "value": round(delta, 4),
+            "unit": "ms/step",
+            "overhead_ms_megakernel": round(delta, 4),
+            "step_ms": round(plain_ms, 4),
+            "megakernel_step_ms": round(mk_ms, 4),
+            "overhead_iqr_ms": [round(q1, 4), round(q3, 4)],
+            "overhead_rounds_ms": [round(d, 4) for d in diffs],
+        }))
+        return
 
     if os.environ.get("DGC_TELEMETRY_AB", "") == "1":
         # telemetry-overhead A/B: the pair is dgc+telemetry vs dgc, SAME

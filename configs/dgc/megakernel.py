@@ -1,0 +1,22 @@
+"""Flag module: two-megakernel DGC hot path (opt-in).
+
+Collapses the per-bucket compensate → momentum-correct → threshold →
+select → pack chain into ONE streamed Pallas pass per eligible bucket
+(``kernels.dgc_forward_rows`` — candidates never round-trip through
+HBM between stages) and the unpack → decompress-divide → scatter-apply
+→ transmit-record chain into ONE pass (``kernels.dgc_apply_rows``).
+Subsumes `fusedapply.py` on the buckets it owns and lifts the fused
+selector's ``max_sel <= 128`` reference-delegate cliff via multi-round
+in-VMEM selection (k up to 1024). Bitwise-equal to the plain engine
+(tests/test_megakernel.py pins 3-step W=8 parity including the
+sent-bits fold-back); ineligible buckets — segmented/3-D layouts,
+non-f32 state, lane-misaligned spans, k > 1024 — silently fall back.
+A/B it paired with ``scripts/bench_model.py --megakernel-ab`` or
+``DGC_MEGAKERNEL_AB=1 python bench.py``; plain opt-in via
+``DGC_MEGAKERNEL=1`` or this config. Off by default pending on-chip
+acceptance (docs/RESULTS.md round 16).
+"""
+
+from dgc_tpu.utils.config import configs
+
+configs.train.compression.megakernel = True

@@ -72,6 +72,10 @@ class FlatEqn:
     params: Dict
     source: str          # "path/to/file.py:123 (fn_name)" or ""
     depth: int           # call-nesting depth (0 = top level)
+    #: equation lives inside a pallas_call body: its outputs are VMEM
+    #: scratch / block refs, not HBM allocations — liveness accounting
+    #: skips them (the kernel's HBM traffic is the call's own operands)
+    vmem: bool = False
 
 
 @dataclass
@@ -172,14 +176,14 @@ class _Flattener:
     def run(self, closed) -> FlatProgram:
         jaxpr, _ = _open(closed)
         self.prog.invars = tuple(self._gid(v) for v in jaxpr.invars)
-        self._walk(closed, depth=0)
+        self._walk(closed, depth=0, vmem=False)
         self.prog.outvars = tuple(
             g for g in (self._gid(v) for v in jaxpr.outvars)
             if g is not None)
         return self.prog
 
     # -- core recursion --------------------------------------------------
-    def _walk(self, closed, depth: int) -> None:
+    def _walk(self, closed, depth: int, vmem: bool = False) -> None:
         jaxpr, _ = _open(closed)
         for cv in jaxpr.constvars:
             self._gid(cv)
@@ -192,11 +196,12 @@ class _Flattener:
             if not subs:
                 outs = tuple(self._gid(v) for v in eqn.outvars)
                 self.prog.eqns.append(FlatEqn(
-                    name, ins, outs, dict(eqn.params), src, depth))
+                    name, ins, outs, dict(eqn.params), src, depth, vmem))
                 continue
-            self._inline(eqn, name, ins, src, subs, depth)
+            self._inline(eqn, name, ins, src, subs, depth, vmem)
 
-    def _inline(self, eqn, name, ins, src, subs, depth) -> None:
+    def _inline(self, eqn, name, ins, src, subs, depth,
+                vmem: bool = False) -> None:
         """Inline one call equation. Records a marker FlatEqn for the
         call itself (no dataflow — the sub-jaxpr carries it), or a
         bridge FlatEqn (full dataflow) when binders can't be aliased."""
@@ -218,7 +223,7 @@ class _Flattener:
                 sj, _ = _open(sub)
                 for bv, gid in zip(sj.invars, in_gids[1:]):
                     self._alias(bv, gid)
-                self._walk(sub, depth + 1)
+                self._walk(sub, depth + 1, vmem)
             # every branch writes the same call outputs: alias the call
             # outvars to each branch's outvars via a join eqn
             out_gids = tuple(self._gid(v) for v in eqn.outvars)
@@ -230,7 +235,7 @@ class _Flattener:
                     if g is not None)
             self.prog.eqns.append(FlatEqn(
                 f"{name}[join]", tuple(join_ins), out_gids,
-                {}, src, depth))
+                {}, src, depth, vmem))
             return
 
         if positional:
@@ -238,7 +243,7 @@ class _Flattener:
             sj, _ = _open(sub)
             for bv, gid in zip(sj.invars, in_gids):
                 self._alias(bv, gid)
-            self._walk(sub, depth + 1)
+            self._walk(sub, depth + 1, vmem)
             out_gids = tuple(self._gid(v) for v in eqn.outvars)
             sub_outs = tuple(
                 g for g in (self._gid(v) for v in sj.outvars)
@@ -246,26 +251,31 @@ class _Flattener:
             # scan's ys outputs are stacked copies of the body outs; a
             # join eqn keeps the dependency without claiming identity
             self.prog.eqns.append(FlatEqn(
-                f"{name}[join]", sub_outs, out_gids, {}, src, depth))
+                f"{name}[join]", sub_outs, out_gids, {}, src, depth, vmem))
             return
 
         # irregular arity (while, pallas_call, unknown callers): walk
         # sub-jaxprs with fresh binders bridged all-to-all — reachability
-        # over-approximates, collectives inside are still found
+        # over-approximates, collectives inside are still found. Inside a
+        # pallas_call body every binder is a VMEM block ref or scratch —
+        # the bind eqn (which defines the fresh binders) and the whole
+        # sub-walk carry vmem=True so liveness accounting skips them;
+        # the join eqn defines the call's real HBM outputs at caller scope
+        sub_vmem = vmem or name == "pallas_call"
         bridge_outs: List[int] = []
         for _, sub in subs:
             sj, _ = _open(sub)
             fresh_ins = tuple(self._fresh(v) for v in sj.invars)
             self.prog.eqns.append(FlatEqn(
-                f"{name}[bind]", ins, fresh_ins, {}, src, depth))
-            self._walk(sub, depth + 1)
+                f"{name}[bind]", ins, fresh_ins, {}, src, depth, sub_vmem))
+            self._walk(sub, depth + 1, sub_vmem)
             bridge_outs.extend(
                 g for g in (self._gid(v) for v in sj.outvars)
                 if g is not None)
         out_gids = tuple(self._gid(v) for v in eqn.outvars)
         self.prog.eqns.append(FlatEqn(
             f"{name}[join]", tuple(ins) + tuple(bridge_outs), out_gids,
-            {}, src, depth))
+            {}, src, depth, vmem))
 
 
 def flatten(closed) -> FlatProgram:
@@ -355,7 +365,21 @@ def peak_live_bytes(prog: FlatProgram) -> int:
     scheduler and fusions will do better; the point is a stable,
     config-comparable number the regression gate can watch — a doubled
     peak means a donation or an accidental full-buffer copy went
-    missing, whatever the compiler then salvages."""
+    missing, whatever the compiler then salvages.
+
+    Values defined INSIDE a pallas_call body (``FlatEqn.vmem``) are
+    block refs and VMEM scratch, not HBM allocations — they are
+    excluded, so a fused-kernel build is compared on the same HBM
+    footing as the staged XLA build it replaces (the kernel's real HBM
+    traffic is the call's own operands, which stay counted)."""
+    onchip: Set[int] = {v for e in prog.eqns if e.vmem
+                        for v in e.outvars if v is not None}
+
+    def _bytes(v) -> int:
+        if v in onchip:
+            return 0
+        return aval_bytes(prog.avals.get(v))
+
     last_use: Dict[int, int] = {}
     for i, e in enumerate(prog.eqns):
         for v in e.invars:
@@ -364,15 +388,15 @@ def peak_live_bytes(prog: FlatProgram) -> int:
     for v in prog.outvars:
         last_use[v] = n
     live: Set[int] = set(prog.invars)
-    peak = cur = sum(aval_bytes(prog.avals.get(v)) for v in live)
+    peak = cur = sum(_bytes(v) for v in live)
     for i, e in enumerate(prog.eqns):
         for v in e.outvars:
             if v is not None and v not in live:
                 live.add(v)
-                cur += aval_bytes(prog.avals.get(v))
+                cur += _bytes(v)
         peak = max(peak, cur)
         for v in set(e.invars) | set(e.outvars):
             if v in live and last_use.get(v, -1) <= i:
                 live.discard(v)
-                cur -= aval_bytes(prog.avals.get(v))
+                cur -= _bytes(v)
     return int(peak)

@@ -18,6 +18,10 @@ tests exercise:
   the state buffers (param 0 included); donate=False aliases nothing.
 * **fused-apply epilogue is barrier-free**: kernels.payload_apply_bits
   lowers without optimization_barrier ops (PR 1's fused epilogue).
+* **megakernels cost nothing off, no collectives on**: megakernel=False
+  is byte-identical to a build that never mentioned the flag (neither
+  fused kernel body lowers); megakernel=True changes per-bucket compute
+  only — zero all-gather / all-reduce delta vs the plain build.
 * **adaptive degradation rides the fleet gather**: adaptive=None on a
   fleet build is byte-identical to a fleet build that never mentioned
   adaptive (zero resilience/adaptive code lowers); adaptive=on adds ZERO
@@ -476,6 +480,32 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
             step_a(state_a, images_a, labels_a, jax.random.PRNGKey(3))
         return out
     run("autotune-replan-pins-compile", autotune_pin)
+
+    # two-megakernel hot path (ISSUE 16): megakernel=False must be
+    # byte-identical to a build that never mentioned the flag, with
+    # neither fused kernel body (_dgc_forward_kernel / _dgc_apply_kernel)
+    # lowered into the step — the gate is Python-static, like telemetry
+    _, step_mkoff, _, _ = build_fixture(
+        mesh, donate=False, telemetry=False,
+        compressor_kwargs={"megakernel": False})
+    mkoff = _step_contract(
+        "megakernel-off-compiles-away", state, step_mkoff, inputs,
+        forbid_substrings=["_dgc_forward_kernel", "_dgc_apply_kernel"],
+        identical_to=plain)
+    run(mkoff.name, mkoff.check)
+
+    # megakernel on: the fused forward/apply passes restructure
+    # per-bucket COMPUTE only — the wire protocol (payload lanes,
+    # transmit record) is untouched, so the collective count is exactly
+    # the plain build's (zero all-gather / all-reduce delta)
+    state_mk, step_mkon, _, _ = build_fixture(
+        mesh, donate=False, telemetry=False,
+        compressor_kwargs={"megakernel": True})
+    mkon = _step_contract(
+        "megakernel-on-no-new-collectives", state_mk, step_mkon, inputs,
+        collectives_delta=(plain, {"all-gather": 0, "all-reduce": 0}),
+        no_f64=True)
+    run(mkon.name, mkon.check)
 
     run("fused-epilogue-no-opt-barriers",
         lambda: _epilogue_contract().check())

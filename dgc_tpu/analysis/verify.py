@@ -420,6 +420,13 @@ def _configs():
         ("fused_select", False, lambda: dict(
             donate=False, telemetry=False,
             compressor_kwargs={"fused_select": True})),
+        ("megakernel", False, lambda: dict(
+            donate=False, telemetry=False,
+            compressor_kwargs={"megakernel": True})),
+        ("megakernel_fused", False, lambda: dict(
+            donate=False, telemetry=False,
+            compressor_kwargs={"megakernel": True, "fused_apply": True,
+                               "fused_select": True})),
         ("fleet", True, lambda: dict(donate=False, telemetry=True,
                                      fleet=True)),
         ("adaptive", True, lambda: _adaptive_kwargs()),
@@ -524,6 +531,25 @@ def run_verify_suite(mesh=None, log: Callable[[str], None] = None,
             report["configs"][name]["plan"] = {
                 k: list(v) if isinstance(v, tuple) else v
                 for k, v in desc.items()}
+
+    # DGCV03 corollary (ISSUE 16): the fused hot path may not RAISE the
+    # static peak-live-bytes over the unfused build — the megakernels
+    # exist to keep candidate buffers in VMEM, so nothing new may stay
+    # simultaneously live in the traced step's HBM picture
+    plain_cfg = report["configs"].get("plain")
+    for mk_name in ("megakernel", "megakernel_fused"):
+        mk_cfg = report["configs"].get(mk_name)
+        if not (mk_cfg and plain_cfg):
+            continue
+        viol = []
+        if mk_cfg["peak_live_bytes"] > plain_cfg["peak_live_bytes"]:
+            viol.append(
+                f"fused build's peak_live_bytes "
+                f"{mk_cfg['peak_live_bytes']} exceeds the unfused "
+                f"build's {plain_cfg['peak_live_bytes']} — the "
+                "megakernel path is materializing an intermediate the "
+                "staged path never held live")
+        results.append((f"verify[{mk_name}].peak-live-vs-unfused", viol))
 
     # donation pass: one compile, on the donated build
     if not fast:

@@ -98,6 +98,7 @@ class DGCCompressor(Compressor):
                  checksum: bool = False,
                  fused_apply: bool = False,
                  fused_select: bool = False,
+                 megakernel: bool = False,
                  approx_recall: float = 0.90, verbose: bool = False):
         self.fp16_values = fp16_values
         #: fused apply epilogue (flat engine only): after the gathers,
@@ -123,6 +124,22 @@ class DGCCompressor(Compressor):
         #: (same tie order as the top-k kernel, values read at the
         #: selected coordinates).
         self.fused_select = fused_select
+        #: two-megakernel hot path (flat engine only): the WHOLE
+        #: compressed-side step collapses into two streaming Pallas
+        #: passes — ``dgc_forward_rows`` (masked error-feedback
+        #: compensate -> momentum correction -> threshold -> multi-round
+        #: select -> pack, per eligible bucket; candidate values/indices
+        #: never leave VMEM) and ``dgc_apply_rows`` (unpack ->
+        #: decompress divide -> scatter-apply -> transmit-record pack;
+        #: the divided wire never materializes). Subsumes ``fused_apply``
+        #: and ``fused_select`` on the buckets it owns; ineligible
+        #: buckets (layout-free selection, oversize rows, narrow state)
+        #: keep their existing paths. Bitwise parity with the unfused
+        #: engine is pinned at kernel and engine level
+        #: (tests/test_megakernel.py); off by default pending the paired
+        #: on-chip A/B (docs/RESULTS.md round 16). Also switchable via
+        #: ``DGC_MEGAKERNEL=1`` or configs/dgc/megakernel.py.
+        self.megakernel = megakernel
         #: int8-quantized wire values with one f32 scale per TENSOR
         #: (scale = max|payload|/127, round-to-nearest, symmetric):
         #: addresses the reference's own stated caveat — "no

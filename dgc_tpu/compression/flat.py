@@ -828,6 +828,20 @@ class FlatDGCEngine:
         #: (kernels.fused_compensate_bits_cands) instead of a standalone
         #: kernel re-reading the velocity it just wrote
         self._seg_fused = any(self._use_seg_kernel(b) for b in sparse)
+        #: two-megakernel hot path: opt-in via
+        #: ``DGCCompressor(megakernel=True)`` / configs/dgc/megakernel.py
+        #: / ``DGC_MEGAKERNEL=1``. Plan-static — when off, nothing below
+        #: is traced and the program is byte-identical to the unfused
+        #: engine (contract: megakernel-off-compiles-away).
+        self._megakernel = bool(
+            getattr(compressor, "megakernel", False)
+            or os.environ.get("DGC_MEGAKERNEL", "") == "1")
+        #: bucket ids the forward megakernel owns (one fused
+        #: compensate->threshold->select->pack pass each); the
+        #: complement spans keep the plain compensate and their usual
+        #: selection paths
+        self._mk_fwd_ids = tuple(
+            bi for bi in self._sparse_ids if self._use_megakernel_fwd(bi))
 
     def _legacy_regime(self) -> str:
         """The uniform wire regime the compressor flags describe — what
@@ -1417,8 +1431,122 @@ class FlatDGCEngine:
         fused and unfused paths select bitwise-identical payloads
         (pinned in tests/test_kernels.py)."""
         return (getattr(self.c, "fused_select", False)
-                and b.max_sel <= 128
-                and b.max_sel * b.cols <= 2_000_000)
+                and b.max_sel <= kernels._MR_MAX_K
+                and b.max_sel * b.cols <= (2_000_000
+                                           if kernels._interpret()
+                                           else 16_000_000))
+
+    def _use_megakernel_fwd(self, bi: int) -> bool:
+        """Whether bucket ``bi``'s compensate + selection runs the
+        forward megakernel (kernels.dgc_forward_rows): masked
+        error-feedback compensate -> momentum correction -> threshold
+        mask -> multi-round in-VMEM select -> pack, ONE Pallas pass —
+        the compensated gradient and the candidate (value, column)
+        pairs never round-trip through HBM between the compensate and
+        select launches. Plan-static gates: the megakernel opt-in, an
+        error-feedback memory with f32 state and gradient (the kernel
+        refuses narrow state; bf16 error feedback keeps the unfused
+        path), a plain 2-D selection bucket (seg-kernel / 3-D buckets
+        keep their own fused candidate stream), kernel geometry (k
+        within the multi-round bound, one whole row VMEM-resident),
+        and a serial-interpreter work bound off-TPU (oversize buckets
+        silently keep the unfused path there — the `_use_fused_apply`
+        convention, so the CPU parity oracles stay fast)."""
+        if not self._megakernel or self._mem is None:
+            return False
+        b = self.buckets[bi]
+        if self._use_seg_kernel(b) or self._use_3d(b):
+            return False
+        sdt = self._mem.dtype or self.layout.dtype
+        if (np.dtype(sdt) != np.dtype(np.float32)
+                or np.dtype(self.layout.dtype) != np.dtype(np.float32)):
+            return False
+        if not (0 < b.max_sel <= min(b.cols, kernels._MR_MAX_K)):
+            return False
+        if b.base % kernels._LANE or b.cols % kernels._LANE:
+            return False
+        # one row (grad+mmt+vec streams + selection carry) must fit the
+        # kernel's VMEM budget; wider buckets are layout-free-path
+        # territory anyway
+        if b.cols > 128 * 1024:
+            return False
+        if kernels._interpret() and b.rows * b.cols * b.max_sel > 50_000_000:
+            return False
+        return True
+
+    def _use_megakernel_apply(self, m, int8_ef: bool, dt) -> bool:
+        """Whether the post-gather epilogue runs the apply megakernel
+        (kernels.dgc_apply_rows): the fused-apply pass with the
+        worker-average decompress divide folded into the kernel body,
+        so the divided [W * payload] wire never materializes in HBM.
+        Same preconditions as :meth:`_use_fused_apply`, keyed on the
+        megakernel opt-in instead of ``fused_apply``."""
+        if not self._megakernel:
+            return False
+        if kernels._interpret() and self.payload_size > 4096:
+            return False
+        return (m is not None and not int8_ef
+                and dt == jnp.float32
+                and self.T % kernels._LANE == 0)
+
+    def _compensate_megakernel(self, mmt, vec, grad, sent_bits):
+        """Forward-megakernel compensate over [0, T): eligible buckets
+        (``_mk_fwd_ids``) run kernels.dgc_forward_rows — ONE pass per
+        bucket emitting the compensated state AND the packed selection
+        (scores, signed values, columns), which :meth:`sparsify`
+        consumes via ``fwd_sel`` instead of relaunching a selection
+        kernel over state it would re-read from HBM. Complement spans
+        (dense-planned slabs, ineligible buckets, alignment gaps) keep
+        the plain fused compensate, windowed onto the span by
+        kernels.realign_bits (bitwise the full-record expansion).
+        Reassembly is base-order concatenation — every element takes
+        exactly the unfused pass's op sequence, so engine-level parity
+        is bitwise (pinned in tests/test_megakernel.py).
+
+        Returns ``(comp, mmt', vec', fwd_sel)`` with ``comp is vec'``
+        (deferred masking applies on read; the compensated gradient IS
+        the velocity, as on :meth:`_compensate_acc`'s bits path)."""
+        m = self._mem
+        T = self.T
+        g = grad if grad.shape[0] == T else grad[:T]
+        segs = []
+        pos = 0
+        for bi in sorted(self._mk_fwd_ids,
+                         key=lambda i: self.buckets[i].base):
+            b = self.buckets[bi]
+            if b.base > pos:
+                segs.append((pos, b.base, None))
+            segs.append((b.base, b.base + b.rows * b.cols, bi))
+            pos = b.base + b.rows * b.cols
+        if pos < T:
+            segs.append((pos, T, None))
+        mparts, vparts = [], []
+        fwd_sel = {}
+        for lo, hi, bi in segs:
+            gs, ms, vs = g[lo:hi], mmt[lo:hi], vec[lo:hi]
+            if bi is None:
+                span_bits = kernels.realign_bits(sent_bits, lo, hi - lo)
+                if kernels.use_pallas():
+                    ms, vs = kernels.fused_compensate_bits(
+                        gs, ms, vs, span_bits, m.momentum, m.nesterov,
+                        m.momentum_masking)
+                else:
+                    ms, vs = kernels.fused_compensate_bits_reference(
+                        gs, ms, vs, span_bits, m.momentum, m.nesterov,
+                        m.momentum_masking)
+            else:
+                b = self.buckets[bi]
+                with _trace.phase("forward", bi):
+                    ms, vs, s, v, c = kernels.dgc_forward_rows(
+                        gs, ms, vs, sent_bits, lo,
+                        jnp.asarray(b.numels, jnp.int32), b.max_sel,
+                        m.momentum, m.nesterov, m.momentum_masking)
+                fwd_sel[bi] = (s, v, c)
+            mparts.append(ms)
+            vparts.append(vs)
+        mmt = mparts[0] if len(mparts) == 1 else jnp.concatenate(mparts)
+        vec = vparts[0] if len(vparts) == 1 else jnp.concatenate(vparts)
+        return vec, mmt, vec, fwd_sel
 
     def _sample_rows_3d(self, b: "_Bucket", v2d: jax.Array,
                         k: jax.Array) -> jax.Array:
@@ -1611,13 +1739,22 @@ class FlatDGCEngine:
         return vals, gidx
 
     def sparsify(self, vec_c: jax.Array, key: jax.Array, seg_cands=None,
-                 stats_out: Optional[Dict] = None):
+                 fwd_sel=None, stats_out: Optional[Dict] = None):
         """Sampled-top-k selection over the compressed block [T].
 
         ``seg_cands`` — optional ``(cand_vals, cand_blks)`` from the
         fused compensate pass (kernels.fused_compensate_bits_cands);
         seg-kernel buckets then slice their segments instead of
         re-reading the flat buffer.
+
+        ``fwd_sel`` — optional dict ``{bucket id: (scores, values,
+        columns)}`` from the forward megakernel
+        (:meth:`_compensate_megakernel`): those buckets' selections
+        were already extracted inside the compensate pass (bitwise
+        kernels.select_pack_rows on the compensated block), so their
+        select stage here is a dict lookup — no kernel launch, no
+        re-read of the velocity. Thresholding, adaptation, and
+        validity masking run unchanged on the fused scores.
 
         ``stats_out`` — optional dict the telemetry taps fill with
         per-bucket selection stats (selected_frac, threshold,
@@ -1700,7 +1837,13 @@ class FlatDGCEngine:
                 # (adaptation is statically off: numel == num_samples).
                 scores = imp_rows
                 with _trace.phase("select", bi):
-                    if self._use_fused_select(b):
+                    fused = (fwd_sel or {}).get(bi)  # plan-static dict, not a tracer
+                    if fused is not None:
+                        # selection already emitted by the forward
+                        # megakernel's compensate pass (bitwise
+                        # select_pack_rows on the same block)
+                        top_scores, fvals, cols = fused
+                    elif self._use_fused_select(b):
                         # fused threshold->select->pack: the kernel masks
                         # by numel, extracts the top set, and emits the
                         # SIGNED payload values in the same pass — the
@@ -1763,7 +1906,13 @@ class FlatDGCEngine:
             # depend on thr), so the resample ladder can be derived from
             # the top-k values with no extra pass over the block.
             with _trace.phase("select", bi):
-                if self._use_fused_select(b):
+                fused = (fwd_sel or {}).get(bi)  # plan-static dict, not a tracer
+                if fused is not None:
+                    # forward-megakernel selection (see the exact branch
+                    # above); threshold adaptation below still uses
+                    # top_scores
+                    top_scores, fvals, cols = fused
+                elif self._use_fused_select(b):
                     # fused selection (see the exact branch above): the
                     # signed payload values ride out of the same pass;
                     # threshold adaptation below still uses top_scores
@@ -2002,6 +2151,7 @@ class FlatDGCEngine:
 
         # --- compressed block: masked compensate -> sparsify -> gather ---
         cands = None
+        fwd_sel = None
         if m is not None:
             if clip is not None:
                 # clipping runs on the LOCAL gradient inside the accumulating
@@ -2025,10 +2175,21 @@ class FlatDGCEngine:
             # transmit record is applied on read inside the compensate
             # pass. x*0 == set-to-0 for finite values, and the sentinel
             # slot is a structural zero, so padded payload slots are no-ops.
-            with _trace.phase("compensate"):
-                comp, mc, vc, cands = self._compensate_acc(
-                    mc, vc, gsrc, mem["sent_bits"],
-                    want_cands=self._seg_fused)
+            if self._mk_fwd_ids:
+                # forward megakernel (plan-static opt-in): eligible
+                # buckets fuse compensate -> threshold -> select -> pack
+                # into one pass each; sparsify consumes the selections
+                # via fwd_sel below. Seg-kernel buckets (if any coexist)
+                # fall back to the standalone candidates kernel — the
+                # megakernel path does not thread want_cands.
+                with _trace.phase("forward"):
+                    comp, mc, vc, fwd_sel = self._compensate_megakernel(
+                        mc, vc, gsrc, mem["sent_bits"])
+            else:
+                with _trace.phase("compensate"):
+                    comp, mc, vc, cands = self._compensate_acc(
+                        mc, vc, gsrc, mem["sent_bits"],
+                        want_cands=self._seg_fused)
         else:
             comp = gc
         if os.environ.get("DGC_VERIFY_MUTATE", "") == "cast_bf16":
@@ -2038,6 +2199,7 @@ class FlatDGCEngine:
             comp = comp.astype(jnp.bfloat16).astype(flat_grad.dtype)
         sel_stats: Optional[Dict] = {} if telemetry else None
         values, indices = self.sparsify(comp, key, seg_cands=cands,
+                                        fwd_sel=fwd_sel,
                                         stats_out=sel_stats)
         # tag the selection BEFORE the adaptive mask: masked derivations
         # must stay tainted so conservation covers the withheld tail too
@@ -2318,9 +2480,31 @@ class FlatDGCEngine:
         # scatter-set into the live mmt/vec buffers (1.8 ms) and sub-word
         # masks (serial while-loop) stay avoided.
         wire = g_values.reshape(-1).astype(dt)
-        if op == "average":
+        mk_apply = self._use_megakernel_apply(m, int8_ef, dt)
+        if op == "average" and not mk_apply:
             wire = wire / world_size
-        if self._use_fused_apply(m, int8_ef, dt):
+        if mk_apply:
+            # apply megakernel (kernels.dgc_apply_rows): the fused-apply
+            # epilogue below with the worker-average decompress divide
+            # folded into the kernel body — the divided [W * payload]
+            # wire intermediate never materializes in HBM; each staged
+            # entry divides in-register on its way into the
+            # VMEM-resident output chunk. The per-entry IEEE divide and
+            # the stable staging sort keep duplicate contributions in
+            # payload order, so values AND transmit record stay bitwise
+            # the unfused path's (pinned in tests/test_megakernel.py).
+            with _trace.phase("apply"):
+                me = jax.lax.axis_index(axis_name)
+                rows = jnp.arange(g_indices.shape[0],
+                                  dtype=jnp.int32)[:, None]
+                flags = ((rows == me)
+                         & (g_indices != self.layout.sentinel)).reshape(-1)
+                acc, new_bits = kernels.dgc_apply_rows(
+                    wire, g_indices.reshape(-1), flags, T,
+                    bits_donor=mem["sent_bits"],
+                    divisor=(float(world_size) if op == "average"
+                             else None))
+        elif self._use_fused_apply(m, int8_ef, dt):
             # fused apply epilogue (kernels.payload_apply_bits): the
             # decompress scatter-add AND the transmit-record pack ride
             # one streamed Pallas pass over [T] — the payload is

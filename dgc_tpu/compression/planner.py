@@ -118,6 +118,18 @@ class CostModel(NamedTuple):
     pack_ms_per_elem: float = 2.0e-7
     #: scatter-add apply per gathered payload element (x W)
     apply_ms_per_elem: float = 1.0e-8
+    #: --- megakernel coefficients (trailing fields: positional
+    #: constructions from before the two-megakernel path stay valid).
+    #: One streaming compensate->select->pack pass replaces the
+    #: per-piece launches, so the fused compute side is modeled as a
+    #: smaller per-bucket fixed cost plus a bandwidth-bound per-element
+    #: scan; the fused apply folds the decompress divide into the same
+    #: pass that scatters. Defaults are the modeled ~2x launch/stream
+    #: reduction the ISSUE-16 CPU evidence pins (on-chip refit pending,
+    #: docs/RESULTS.md round 16). ---
+    fused_fixed_ms_per_bucket: float = 0.008
+    fused_select_ms_per_elem: float = 1.5e-7
+    fused_apply_ms_per_elem: float = 0.6e-8
 
 
 DEFAULT_COST = CostModel()
@@ -301,19 +313,34 @@ def bucket_ms_from_profile(profile: Optional[Dict],
 
 def _regime_costs(g: BucketGeom, fabric: Fabric, world: int,
                   cost: CostModel, bucket_ms: Optional[float],
-                  value_itemsize: int, index_itemsize: int
-                  ) -> Dict[str, float]:
-    """Predicted exchange ms of one bucket under every candidate regime."""
+                  value_itemsize: int, index_itemsize: int,
+                  megakernel: bool = False) -> Dict[str, float]:
+    """Predicted exchange ms of one bucket under every candidate regime.
+
+    ``megakernel=True`` prices the compute side with the fused
+    coefficients (``fused_*`` CostModel fields): the two-megakernel
+    path replaces the per-piece compensate/threshold/select/pack and
+    divide/scatter/record launches with one streaming pass per side,
+    so per-bucket fixed cost and the per-element scan both shrink —
+    which moves the sparse-vs-dense crossover on fast fabrics, exactly
+    what the autotuner refits against. A measured ``bucket_ms``
+    profile (recorded under whichever path produced it) overrides the
+    coefficients either way."""
     bw = fabric.gbps * 1e6            # bytes per ms
     a = fabric.alpha_ms
 
     def wire(nbytes, lanes):
         return lanes * a + (world - 1) * nbytes / bw
 
+    fixed = (cost.fused_fixed_ms_per_bucket if megakernel
+             else cost.fixed_ms_per_bucket)
+    sel = (cost.fused_select_ms_per_elem if megakernel
+           else cost.select_ms_per_elem)
+    apl = (cost.fused_apply_ms_per_elem if megakernel
+           else cost.apply_ms_per_elem)
     comp = (bucket_ms if bucket_ms is not None
-            else cost.fixed_ms_per_bucket
-            + cost.select_ms_per_elem * g.numel)
-    comp += cost.apply_ms_per_elem * g.payload * world
+            else fixed + sel * g.numel)
+    comp += apl * g.payload * world
     quant = cost.quant_ms_per_elem * g.payload * (1 + world)
     pack = cost.pack_ms_per_elem * g.payload * (1 + world)
     scales = 4 * g.rows
@@ -491,9 +518,12 @@ def plan_buckets(geoms: Sequence[BucketGeom], *, fabric,
                  bucket_ms: Optional[Sequence[float]] = None,
                  candidates: Sequence[str] = REGIMES,
                  value_itemsize: int = 4,
-                 index_itemsize: int = 4) -> Plan:
+                 index_itemsize: int = 4,
+                 megakernel: bool = False) -> Plan:
     """Choose the cheapest regime per bucket. Ties break toward the
-    earlier candidate (``dense`` first — the never-lose direction)."""
+    earlier candidate (``dense`` first — the never-lose direction).
+    ``megakernel`` prices compute with the fused coefficients (see
+    :func:`_regime_costs`)."""
     fabric = resolve_fabric(fabric)
     world = int(world or fabric.workers)
     regimes, tables = [], []
@@ -501,7 +531,8 @@ def plan_buckets(geoms: Sequence[BucketGeom], *, fabric,
         bm = (float(bucket_ms[i])
               if bucket_ms is not None and i < len(bucket_ms) else None)
         costs = _regime_costs(g, fabric, world, cost, bm,
-                              value_itemsize, index_itemsize)
+                              value_itemsize, index_itemsize,
+                              megakernel=megakernel)
         best = min(candidates, key=lambda r: (costs[r],
                                               candidates.index(r)))
         regimes.append(best)
@@ -513,16 +544,22 @@ def plan_buckets(geoms: Sequence[BucketGeom], *, fabric,
 def plan_engine(engine, fabric=None, profile: Optional[Dict] = None,
                 world: Optional[int] = None,
                 cost: CostModel = DEFAULT_COST,
-                candidates: Sequence[str] = REGIMES) -> Plan:
+                candidates: Sequence[str] = REGIMES,
+                megakernel: Optional[bool] = None) -> Plan:
     """Plan over a built ``FlatDGCEngine``'s buckets. ``profile`` is an
     ``attrib.profile_json`` dict (or None for the coefficient model);
-    ``fabric`` resolves through :func:`resolve_fabric`."""
+    ``fabric`` resolves through :func:`resolve_fabric`. ``megakernel``
+    defaults to the engine's own compressor flag so a megakernel build
+    is automatically priced with the fused coefficients."""
     fabric = resolve_fabric(fabric)
     geoms = [bucket_geometry(b) for b in engine.buckets]
     bm = bucket_ms_from_profile(profile, len(geoms))
     itemsize = int(np.dtype(engine.layout.dtype).itemsize)
     idx_size = int(np.dtype(np.int64).itemsize
                    if str(engine.index_dtype).endswith("64") else 4)
+    if megakernel is None:
+        megakernel = bool(getattr(engine, "_megakernel", False))
     return plan_buckets(geoms, fabric=fabric, world=world, cost=cost,
                         bucket_ms=bm, candidates=candidates,
-                        value_itemsize=itemsize, index_itemsize=idx_size)
+                        value_itemsize=itemsize, index_itemsize=idx_size,
+                        megakernel=megakernel)

@@ -22,6 +22,34 @@ bitwise — tested in tests/test_kernels.py):
   scans); counts for the whole ladder make the final threshold a closed-form
   pick (see ``flat.FlatDGCEngine``).
 
+* :func:`dgc_forward_rows` / :func:`dgc_apply_rows` — the two-megakernel
+  step (opt-in via ``DGCCompressor(megakernel=True)``): the whole
+  compress side and the whole apply side each collapse into ONE Pallas
+  pass::
+
+      forward (one pass per eligible bucket, grid = bucket rows)
+          HBM grad/mmt/vec row ──DMA──▶ VMEM
+            └▶ bit-expand keep mask (packed transmit record)
+               └▶ masked error-feedback compensate + momentum correction
+                  └▶ k-round in-VMEM partial selection
+                     (threshold → select → pack, values never respill)
+          ──DMA──▶ HBM mmt' / vec' + (scores, values, cols) payload
+
+      apply (one pass over the flat [T] buffer, grid = payload pages)
+          staged payload page ──scalar prefetch──▶ SMEM
+            └▶ unpack → decompress (divide) → scatter-apply
+               └▶ sent-bits record, same VMEM-resident output block
+          ──DMA──▶ HBM dense grad + packed transmit record
+
+  Double-buffered streaming: both kernels run their HBM operands through
+  the Pallas grid pipeline (the next block's DMA issues while the current
+  block computes; the apply pass additionally scalar-prefetches its
+  page→chunk maps so the output-block revisit pattern is known ahead of
+  the DMAs), so per-bucket cost is bandwidth-bound rather than
+  launch-bound. Between them the unfused path's intermediate HBM
+  round-trips (compensated velocity re-read, candidate buffers, staged
+  importance) disappear.
+
 Kernels run compiled on TPU and in interpreter mode elsewhere (CPU tests);
 ``use_pallas()`` picks automatically.
 """
@@ -32,6 +60,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -52,13 +81,15 @@ __all__ = ["fused_compensate", "fused_compensate_reference",
            "fused_compensate_bits_cands",
            "fused_compensate_bits_cands_reference",
            "keep_from_sent", "pack_sent_bits", "keep_from_bits",
-           "num_sent_words",
+           "num_sent_words", "realign_bits",
            "ladder_counts", "ladder_counts_reference",
            "topk_rows", "topk_rows_reference",
            "select_pack_rows", "select_pack_rows_reference",
            "seg_top2_candidates", "seg_top2_reference",
            "seg_top2_eligible", "opaque_view", "use_pallas",
-           "payload_apply_bits", "payload_apply_bits_reference", "vtag"]
+           "payload_apply_bits", "payload_apply_bits_reference",
+           "dgc_forward_rows", "dgc_forward_rows_reference",
+           "dgc_apply_rows", "dgc_apply_rows_reference", "vtag"]
 
 _LANE = 128          # TPU lane width
 _SUBLANE = 8         # f32 sublane
@@ -356,6 +387,69 @@ def keep_from_bits(bits: jax.Array, total: int) -> jax.Array:
     return keep.reshape(-1)[:total].astype(jnp.float32)
 
 
+def realign_bits(bits: jax.Array, base: int, n: int) -> jax.Array:
+    """Window the packed transmit record onto region ``[base, base+n)``:
+    returns ``num_sent_words(n)`` words such that
+    ``keep_from_bits(out, n) == keep_from_bits(bits, total)[base:base+n]``.
+
+    The word layout ties bit position to ``row % 32`` of the [_, 128]
+    row view, so a region whose start row ``S = base // 128`` is not a
+    multiple of 32 needs a funnel shift across adjacent word groups:
+    ``out[j] = (w[q+j] >>> sh) | (w[q+j+1] << (32-sh))`` with
+    ``q = S // 32``, ``sh = S % 32`` (logical shifts, computed in
+    uint32). ``base``/``n`` are static and lane-aligned (every bucket
+    base and every span the engine builds is — cols are multiples of
+    128); group-aligned regions reduce to a pure slice."""
+    assert base % _LANE == 0 and n % _LANE == 0, (base, n)
+    W = num_sent_words(n)
+    Wr = W // _LANE                       # word groups of the window
+    S = base // _LANE                     # region start row
+    q, sh = S // 32, S % 32
+    w2 = bits.reshape(-1, _LANE)
+    need = q + Wr + 1 - w2.shape[0]       # one zero guard group for hi
+    if need > 0:
+        w2 = jnp.concatenate(
+            [w2, jnp.zeros((need, _LANE), w2.dtype)])
+    if sh == 0:
+        return w2[q:q + Wr].reshape(-1)
+    u = w2.astype(jnp.uint32)
+    lo = u[q:q + Wr]
+    hi = u[q + 1:q + Wr + 1]
+    out = (lo >> jnp.uint32(sh)) | (hi << jnp.uint32(32 - sh))
+    return out.astype(jnp.int32).reshape(-1)
+
+
+def _realign_bits_rows(bits: jax.Array, base: int, R: int,
+                       nblk: int) -> jax.Array:
+    """Per-bucket-row transmit-record windows for the forward megakernel:
+    row ``r`` of a bucket at ``base`` with ``nblk`` 128-lane blocks per
+    row starts at flat row ``S_r = base//128 + r*nblk`` — each needs its
+    own funnel shift (:func:`realign_bits` semantics, vectorized over
+    rows with host-static shift amounts). Returns [R, ceil(nblk/32), 128]
+    int32; word ``j`` of row ``r`` covers the row's local 128-lane blocks
+    ``32j .. 32j+31`` (bit = local block % 32)."""
+    Wr = -(-nblk // 32)
+    S = base // _LANE + np.arange(R, dtype=np.int64) * nblk
+    q = S // 32
+    sh = (S % 32).astype(np.uint32)
+    w2 = bits.reshape(-1, _LANE)
+    need = int(q.max()) + Wr + 1 - w2.shape[0]
+    if need > 0:
+        w2 = jnp.concatenate(
+            [w2, jnp.zeros((need, _LANE), w2.dtype)])
+    u = w2.astype(jnp.uint32)
+    gidx = jnp.asarray(q[:, None] + np.arange(Wr)[None, :], jnp.int32)
+    lo = u[gidx]                                      # [R, Wr, 128]
+    hi = u[gidx + 1]
+    shv = jnp.asarray(sh)[:, None, None]
+    # shift-by-32 is undefined: rows with sh == 0 take lo verbatim and
+    # the dead (32 - sh) lane shifts by 0 instead
+    shl = jnp.asarray(
+        np.where(sh == 0, 0, 32 - sh).astype(np.uint32))[:, None, None]
+    out = jnp.where(shv == jnp.uint32(0), lo, (lo >> shv) | (hi << shl))
+    return out.astype(jnp.int32)
+
+
 def fused_compensate_bits_reference(grad, mmt, vec, bits, momentum: float,
                                     nesterov: bool, momentum_masking: bool):
     """jnp reference: unpack the bit record to a keep mask, then compensate
@@ -374,13 +468,33 @@ def fused_compensate_bits_reference(grad, mmt, vec, bits, momentum: float,
     return om.astype(sdt), ov.astype(sdt)
 
 
+def _compensate_math(g, m0, v0, keep, *, momentum: float, nesterov: bool,
+                     momentum_masking: bool):
+    """The masked-compensate arithmetic every bit-masked kernel shares:
+    mask-on-read then momentum correction, math in the GRADIENT dtype.
+    ONE source of truth so the plain kernel, the fused candidates
+    kernel, and the forward megakernel cannot drift (their state outputs
+    must stay bitwise identical — the fused forms' contract). Returns
+    ``(mmt', vec')`` in the gradient dtype."""
+    m0 = m0.astype(g.dtype)
+    if momentum_masking:
+        m0 = m0 * keep
+    v0 = v0.astype(g.dtype) * keep
+    if nesterov:
+        m = (m0 + g) * momentum
+        ov = v0 + m + g
+    else:
+        m = momentum * m0 + g
+        ov = v0 + m
+    return m, ov
+
+
 def _bits_compensate_core(g_ref, m_ref, v_ref, b_ref, *, momentum: float,
                           nesterov: bool, momentum_masking: bool):
     """Shared VMEM body of the bit-masked compensate kernels: in-VMEM
-    bit expansion + mask-on-read + momentum correction. ONE source of
-    truth so the plain kernel and the fused candidates kernel cannot
-    drift (their state outputs must stay bitwise identical — the fused
-    form's contract). Returns ``(mmt', vec')`` in the gradient dtype.
+    bit expansion + mask-on-read + momentum correction
+    (:func:`_compensate_math`). Returns ``(mmt', vec')`` in the gradient
+    dtype.
 
     Bit expansion: word (a, l) -> rows a*32..a*32+31 of lane l. The
     broadcast+reshape is sublane-local (the lane dim never moves),
@@ -394,17 +508,9 @@ def _bits_compensate_core(g_ref, m_ref, v_ref, b_ref, *, momentum: float,
         rows, _LANE)
     r = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANE), 0)
     keep = (((exp >> (r & 31)) & 1) == 0).astype(g.dtype)
-    m0 = m_ref[:].astype(g.dtype)
-    if momentum_masking:
-        m0 = m0 * keep
-    v0 = v_ref[:].astype(g.dtype) * keep
-    if nesterov:
-        m = (m0 + g) * momentum
-        ov = v0 + m + g
-    else:
-        m = momentum * m0 + g
-        ov = v0 + m
-    return m, ov
+    return _compensate_math(g, m_ref[:], v_ref[:], keep, momentum=momentum,
+                            nesterov=nesterov,
+                            momentum_masking=momentum_masking)
 
 
 def _compensate_bits_kernel(g_ref, m_ref, v_ref, b_ref, om_ref, ov_ref, *,
@@ -760,20 +866,27 @@ def select_pack_rows(x: jax.Array, numels: jax.Array, k: int):
     extractions emits the signed value through a one-hot row sum in the
     same loop iteration that finds the column, so the block is read once.
 
-    Delegation mirrors :func:`topk_rows` (checked FIRST, so a delegating
-    call never pays the pad/up-cast): k beyond the lane width or the row
-    block beyond the VMEM budget falls back to the reference; sub-4-byte
-    inputs up-cast once to f32 (monotone, injective — ordering, ties, and
-    the cast-back values all exact)."""
+    Dispatch: ``k`` beyond :data:`_MR_MAX_K` (or beyond the row width)
+    falls back to the reference; sub-4-byte inputs up-cast once to f32
+    (monotone, injective — ordering, ties, and the cast-back values all
+    exact); ``k`` beyond the lane width or a row block beyond the VMEM
+    budget routes to the chunked multi-round kernel
+    (:func:`_select_pack_rows_mr` — bitwise this same contract), which
+    kills the old ``max_sel <= 128`` reference-delegate cliff (the
+    VGG-16 fc select outlier, 11.3 ms/step of XLA sort); only the small
+    single-block regime keeps this one-pass kernel, byte-identical to
+    its pre-multi-round form."""
     R, cols = x.shape
     numels = numels.astype(jnp.int32)
-    if (k > _LANE or k > cols
-            or 8 * _round_up(cols, _LANE) * max(x.dtype.itemsize, 4)
-            > _TOPK_VMEM_BYTES):
+    if k > _MR_MAX_K or k > cols:
         return select_pack_rows_reference(x, numels, k)
     if x.dtype.itemsize < 4:
         s, v, i = select_pack_rows(x.astype(jnp.float32), numels, k)
         return s.astype(x.dtype), v.astype(x.dtype), i
+    if (k > _LANE
+            or 8 * _round_up(cols, _LANE) * max(x.dtype.itemsize, 4)
+            > _TOPK_VMEM_BYTES):
+        return _select_pack_rows_mr(x, numels, k)
     rpad = (-R) % _SUBLANE
     cpad = (-cols) % _LANE
     if rpad or cpad:
@@ -794,6 +907,132 @@ def select_pack_rows(x: jax.Array, numels: jax.Array, k: int):
         out_shape=(jax.ShapeDtypeStruct((R8, _LANE), x.dtype),
                    jax.ShapeDtypeStruct((R8, _LANE), x.dtype),
                    jax.ShapeDtypeStruct((R8, _LANE), jnp.int32)),
+        in_specs=[spec_x, spec_n],
+        out_specs=(spec_o, spec_o, spec_o),
+        interpret=_interpret(),
+    )(x, numels.reshape(-1, 1))
+    return s[:R, :k], v[:R, :k], i[:R, :k]
+
+
+#: widest selection the multi-round kernel serves (8 output lanes of
+#: 128): beyond it the carry blocks stop paying for themselves vs the
+#: XLA sort and the reference takes over
+_MR_MAX_K = 8 * _LANE
+#: column chunk per multi-round grid step: 8 rows x 16K cols x 4 B =
+#: 512 KB per f32 VMEM stream (values + importance + taken mask + column
+#: iota ≈ 2 MB resident), small enough that the carry blocks and the
+#: next chunk's DMA fit alongside
+_MR_COL_CHUNK = 16 * 1024
+
+
+def _select_pack_mr_kernel(x_ref, n_ref, s_ref, v_ref, i_ref, *, k, kp,
+                           colsp):
+    """One column chunk of the multi-round selection: merge the running
+    top-k carry (the revisited output blocks — the :func:`_ladder_kernel`
+    accumulation pattern) with this chunk's candidates by k rounds of
+    max extraction over their UNION. Ties break to the smallest flat
+    column exactly like :func:`_select_pack_kernel`: carry positions are
+    always left of this chunk's, so first-occurrence order is preserved
+    across chunks and the final blocks are bitwise ``lax.top_k`` over
+    the whole row."""
+    c = pl.program_id(1)
+    x = x_ref[:]                                          # [8, chunk]
+    n = n_ref[:]                                          # [8, 1] int32
+    chunk = x.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    gcol = c * chunk + lane                               # flat columns
+    imp = jnp.where(gcol < n, jnp.abs(x), jnp.full((), -1.0, x.dtype))
+
+    @pl.when(c == 0)
+    def _():
+        # empty carry: importance sentinel -2.0 sits strictly below the
+        # -1.0 structural-pad floor, so a sentinel slot can never win a
+        # round (every chunk offers >= k candidates at >= -1.0); the
+        # position sentinel colsp never collides with a real column
+        s_ref[:] = jnp.full((x.shape[0], kp), -2.0, x.dtype)
+        v_ref[:] = jnp.zeros((x.shape[0], kp), x.dtype)
+        i_ref[:] = jnp.full((x.shape[0], kp), colsp, jnp.int32)
+
+    s0 = s_ref[:]
+    v0 = v_ref[:]
+    i0 = i_ref[:]
+    ko = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], kp), 1)
+
+    def body(j, carry):
+        tc, tk, ns, nv, ni = carry
+        freec = tc == 0
+        freek = tk == 0
+        mc = jnp.max(jnp.where(freec, imp, -jnp.inf), axis=1,
+                     keepdims=True)                       # [8, 1]
+        mk = jnp.max(jnp.where(freek, s0, -jnp.inf), axis=1,
+                     keepdims=True)
+        mx = jnp.maximum(mc, mk)
+        # smallest position attaining the max, across carry AND chunk
+        pc = jnp.min(jnp.where(freec & (imp >= mx), gcol, colsp), axis=1,
+                     keepdims=True)
+        pk = jnp.min(jnp.where(freek & (s0 >= mx), i0, colsp), axis=1,
+                     keepdims=True)
+        pos = jnp.minimum(pc, pk)
+        # the signed value rides from whichever side owns the position
+        # (disjoint: carry positions < c*chunk <= chunk positions)
+        val = (jnp.sum(jnp.where(gcol == pos, x, jnp.zeros((), x.dtype)),
+                       axis=1, keepdims=True)
+               + jnp.sum(jnp.where(freek & (i0 == pos), v0,
+                                   jnp.zeros((), x.dtype)),
+                         axis=1, keepdims=True))
+        ns = jnp.where(ko == j, mx, ns)
+        nv = jnp.where(ko == j, val, nv)
+        ni = jnp.where(ko == j, pos, ni)
+        return (jnp.where(gcol == pos, 1, tc),
+                jnp.where(freek & (i0 == pos), 1, tk), ns, nv, ni)
+
+    _, _, ns, nv, ni = jax.lax.fori_loop(
+        0, k, body,
+        (jnp.zeros(x.shape, jnp.int32),
+         jnp.zeros((x.shape[0], kp), jnp.int32),
+         jnp.full((x.shape[0], kp), -2.0, x.dtype),
+         jnp.zeros((x.shape[0], kp), x.dtype),
+         jnp.full((x.shape[0], kp), colsp, jnp.int32)))
+    s_ref[:] = ns
+    v_ref[:] = nv
+    i_ref[:] = ni
+
+
+def _select_pack_rows_mr(x: jax.Array, numels: jax.Array, k: int):
+    """Chunked multi-round :func:`select_pack_rows` for 128 < k <= 1024
+    or rows beyond the single-block VMEM budget: the row streams through
+    :data:`_MR_COL_CHUNK`-column chunks (inner grid dimension — the
+    Pallas pipeline double-buffers the next chunk's DMA under the
+    current merge) while the running top-k lives in the revisited
+    [8, kp] output blocks. Each chunk runs k merge rounds over carry ∪
+    chunk, so the selection is EXACT — bitwise
+    :func:`select_pack_rows_reference` — where the engine previously
+    delegated to the XLA sort (the VGG-16 fc cliff) or fell back to
+    ``approx_max_k``."""
+    R, cols = x.shape
+    kp = _round_up(k, _LANE)
+    rpad = (-R) % _SUBLANE
+    chunk = min(_MR_COL_CHUNK, _round_up(cols, _LANE))
+    colsp = _round_up(cols, chunk)
+    cpad = colsp - cols
+    if rpad or cpad:
+        # value pad is 0, masked to importance -1 by the padded numels
+        x = jnp.pad(x, ((0, rpad), (0, cpad)))
+    if rpad:
+        numels = jnp.pad(numels, (0, rpad))
+    R8 = R + rpad
+    spec_x = pl.BlockSpec((_SUBLANE, chunk), lambda r, c: (r, c),
+                          memory_space=pltpu.VMEM)
+    spec_n = pl.BlockSpec((_SUBLANE, 1), lambda r, c: (r, 0),
+                          memory_space=pltpu.VMEM)
+    spec_o = pl.BlockSpec((_SUBLANE, kp), lambda r, c: (r, 0),
+                          memory_space=pltpu.VMEM)
+    s, v, i = pl.pallas_call(
+        functools.partial(_select_pack_mr_kernel, k=k, kp=kp, colsp=colsp),
+        grid=(R8 // _SUBLANE, colsp // chunk),
+        out_shape=(jax.ShapeDtypeStruct((R8, kp), x.dtype),
+                   jax.ShapeDtypeStruct((R8, kp), x.dtype),
+                   jax.ShapeDtypeStruct((R8, kp), jnp.int32)),
         in_specs=[spec_x, spec_n],
         out_specs=(spec_o, spec_o, spec_o),
         interpret=_interpret(),
@@ -1102,6 +1341,169 @@ def fused_compensate_bits_cands(grad: jax.Array, mmt: jax.Array,
 
 
 # ------------------------------------------------------------------ #
+# forward megakernel: compensate -> select -> pack, one pass         #
+# ------------------------------------------------------------------ #
+
+def dgc_forward_rows_reference(grad, mmt, vec, bits, base: int,
+                               numels, k: int, momentum: float,
+                               nesterov: bool = False,
+                               momentum_masking: bool = True):
+    """jnp reference of :func:`dgc_forward_rows`: the engine's unfused
+    sequence over one bucket region — window the transmit record
+    (:func:`realign_bits`), bit-masked compensate, then exact
+    select+pack over the [R, cols] row view. ``grad``/``mmt``/``vec``
+    are the flat ``[R * cols]`` region slices."""
+    n = mmt.shape[0]
+    R = numels.shape[0]
+    cols = n // R
+    rb = realign_bits(bits, base, n)
+    om, ov = fused_compensate_bits_reference(grad, mmt, vec, rb, momentum,
+                                             nesterov, momentum_masking)
+    s, v, c = select_pack_rows_reference(
+        ov.reshape(R, cols), jnp.asarray(numels, jnp.int32), k)
+    return om, ov, s, v, c
+
+
+def _dgc_forward_kernel(n_ref, g_ref, m_ref, v_ref, b_ref, om_ref, ov_ref,
+                        s_ref, pv_ref, pi_ref, *, k, kp, cols, momentum,
+                        nesterov, momentum_masking):
+    """One grid step = one bucket row: expand the row's pre-realigned
+    transmit-record window, masked compensate (:func:`_compensate_math`
+    — bitwise the unfused kernels), then k rounds of in-VMEM max
+    extraction over the compensated velocity (same tie order as
+    :func:`_select_pack_kernel`, flat column = 128-block * 128 + lane).
+    The candidate values and indices never leave VMEM between the
+    compensate and the pack."""
+    r = pl.program_id(0)
+    numel = n_ref[r]
+    g = g_ref[...]                                        # [nblk, 128]
+    nblk = g.shape[0]
+    b = b_ref[0]                                          # [Wr, 128]
+    wr = b.shape[0]
+    exp = jnp.broadcast_to(b[:, None, :],
+                           (wr, 32, _LANE)).reshape(wr * 32, _LANE)[:nblk]
+    blk = jax.lax.broadcasted_iota(jnp.int32, (nblk, _LANE), 0)
+    keep = (((exp >> (blk & 31)) & 1) == 0).astype(g.dtype)
+    m, ov = _compensate_math(g, m_ref[...], v_ref[...], keep,
+                             momentum=momentum, nesterov=nesterov,
+                             momentum_masking=momentum_masking)
+    om_ref[...] = m.astype(om_ref.dtype)
+    ov_ref[...] = ov.astype(ov_ref.dtype)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (nblk, _LANE), 1)
+    col = blk * _LANE + lane                              # row-local column
+    imp = jnp.where(col < numel, jnp.abs(ov), jnp.full((), -1.0, ov.dtype))
+    ko = jax.lax.broadcasted_iota(jnp.int32, (1, kp), 1)
+
+    def body(j, carry):
+        taken, s, v, i = carry
+        free = taken == 0
+        m1 = jnp.max(jnp.where(free, imp, -jnp.inf), axis=0, keepdims=True)
+        mx = jnp.max(m1, axis=1, keepdims=True)           # [1, 1]
+        p1 = jnp.min(jnp.where(free & (imp >= mx), col, cols), axis=0,
+                     keepdims=True)
+        pos = jnp.min(p1, axis=1, keepdims=True)          # [1, 1]
+        v1 = jnp.sum(jnp.where(col == pos, ov, jnp.zeros((), ov.dtype)),
+                     axis=0, keepdims=True)
+        val = jnp.sum(v1, axis=1, keepdims=True)          # [1, 1]
+        s = jnp.where(ko == j, mx, s)
+        v = jnp.where(ko == j, val, v)
+        i = jnp.where(ko == j, pos, i)
+        return jnp.where(col == pos, 1, taken), s, v, i
+
+    _, s, v, i = jax.lax.fori_loop(
+        0, k, body,
+        (jnp.zeros((nblk, _LANE), jnp.int32),
+         jnp.full((1, kp), -jnp.inf, ov.dtype),
+         jnp.zeros((1, kp), ov.dtype),
+         jnp.zeros((1, kp), jnp.int32)))
+    s_ref[...] = s
+    pv_ref[...] = v
+    pi_ref[...] = i
+
+
+@functools.partial(jax.jit, static_argnames=("base", "k", "momentum",
+                                             "nesterov", "momentum_masking"))
+def dgc_forward_rows(grad: jax.Array, mmt: jax.Array, vec: jax.Array,
+                     bits: jax.Array, base: int, numels, k: int,
+                     momentum: float, nesterov: bool = False,
+                     momentum_masking: bool = True):
+    """Forward megakernel: masked error-feedback compensate → momentum
+    correction → threshold → select → pack for ONE bucket in ONE Pallas
+    pass (grid = bucket rows, the Pallas pipeline double-buffers each
+    row's five DMA streams under the previous row's extraction rounds).
+
+    The unfused path launches a compensate kernel over [T], spills the
+    compensated velocity to HBM, then re-reads each bucket's region for
+    selection; here the compensated row never leaves VMEM between the
+    momentum correction and the k-round partial selection, and the
+    packed (scores, values, cols) payload is the only selection traffic
+    that touches HBM. Selection is EXACT for any ``k`` up to the
+    multi-round bound — the ``max_sel <= 128`` delegate cliff does not
+    exist on this path.
+
+    ``grad``/``mmt``/``vec`` are the flat ``[R * cols]`` REGION slices
+    (f32 only — the engine gates the bf16 error-feedback state out);
+    ``bits`` is the full-model packed transmit record (windowed per row
+    in-trace via :func:`_realign_bits_rows`); ``numels`` the per-row
+    valid widths; ``base`` the bucket's flat base offset. Returns
+    ``(mmt' [n], vec' [n], scores [R, k], values [R, k], cols [R, k])``
+    — bitwise :func:`dgc_forward_rows_reference`, i.e. bitwise the
+    unfused compensate+select engine sequence. State updates ride
+    in-place via ``input_output_aliases`` like every compensate kernel."""
+    n = mmt.shape[0]
+    R = int(numels.shape[0])
+    if grad.dtype != jnp.float32 or mmt.dtype != jnp.float32 \
+            or vec.dtype != jnp.float32:
+        raise ValueError(
+            "dgc_forward_rows is f32-only (the bf16 error-feedback state "
+            f"must stay on the unfused path): got {grad.dtype}/"
+            f"{mmt.dtype}/{vec.dtype}")
+    assert grad.shape[0] == n and vec.shape[0] == n, (grad.shape, n)
+    assert n % R == 0, (n, R)
+    cols = n // R
+    assert cols % _LANE == 0, cols
+    assert base % _LANE == 0, base
+    assert 0 < k <= min(cols, _MR_MAX_K), (k, cols)
+    nblk = cols // _LANE
+    kp = _round_up(k, _LANE)
+    numels = jnp.asarray(numels, jnp.int32)
+    rb = _realign_bits_rows(bits, base, R, nblk)          # [R, Wr, 128]
+    wr = rb.shape[1]
+    g2, m2, v2 = (a.reshape(R * nblk, _LANE) for a in (grad, mmt, vec))
+
+    dspec = pl.BlockSpec((nblk, _LANE), lambda r, nn: (r, 0),
+                         memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((1, wr, _LANE), lambda r, nn: (r, 0, 0),
+                         memory_space=pltpu.VMEM)
+    ospec = pl.BlockSpec((1, kp), lambda r, nn: (r, 0),
+                         memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(R,),
+        in_specs=[dspec, dspec, dspec, bspec],
+        out_specs=(dspec, dspec, ospec, ospec, ospec),
+    )
+    om, ov, s, v, i = pl.pallas_call(
+        functools.partial(_dgc_forward_kernel, k=k, kp=kp, cols=cols,
+                          momentum=momentum, nesterov=nesterov,
+                          momentum_masking=momentum_masking),
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((R * nblk, _LANE), mmt.dtype),
+                   jax.ShapeDtypeStruct((R * nblk, _LANE), vec.dtype),
+                   jax.ShapeDtypeStruct((R, kp), vec.dtype),
+                   jax.ShapeDtypeStruct((R, kp), vec.dtype),
+                   jax.ShapeDtypeStruct((R, kp), jnp.int32)),
+        # in-place state update (see fused_compensate_bits); indices
+        # count the scalar-prefetch operand first
+        input_output_aliases={2: 0, 3: 1},
+        interpret=_interpret(),
+    )(numels, g2, m2, v2, rb)
+    return (om.reshape(-1), ov.reshape(-1),
+            s[:, :k], v[:, :k], i[:, :k])
+
+
+# ------------------------------------------------------------------ #
 # fused payload-apply epilogue                                       #
 # ------------------------------------------------------------------ #
 
@@ -1124,14 +1526,21 @@ def payload_apply_bits_reference(values, indices, flags, total: int):
     return acc, bits
 
 
-def _payload_apply_kernel(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
-                          pf_ref, bits_donor_ref, acc_ref, bits_ref):
+def _payload_apply_body(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
+                        pf_ref, bits_donor_ref, acc_ref, bits_ref,
+                        divisor):
     """One grid step applies one staged payload page into its chunk's
     VMEM-resident output block. Pages of the same chunk are consecutive
     (the staging sort guarantees it), so the output block revisits are
     consecutive and the accumulation stays in VMEM between pages; the
     first page of each chunk zero-initializes both blocks (every chunk
-    owns at least one page, so every block is fully defined)."""
+    owns at least one page, so every block is fully defined).
+
+    ``divisor`` is a PYTHON-static optional: None traces no divide (the
+    body stays op-for-op what it always was — the megakernel-off
+    byte-identity contract); a float folds the worker average into the
+    same pass (per-entry IEEE divide by the same operand the unfused
+    path uses on the wire, so values stay bitwise)."""
     del bits_donor_ref  # alias donor: never dereferenced
     p = pl.program_id(0)
 
@@ -1145,6 +1554,8 @@ def _payload_apply_kernel(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
     def body(j, carry):
         off = po_ref[0, j]           # in-chunk offset, [0, _APPLY_CHUNK)
         v = pv_ref[0, j]
+        if divisor is not None:
+            v = v / divisor          # fused worker average (decompress)
         f = pf_ref[0, j]
         r = off // _LANE
         c = off % _LANE
@@ -1163,6 +1574,69 @@ def _payload_apply_kernel(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
         return carry
 
     jax.lax.fori_loop(0, cnt_ref[p], body, 0)
+
+
+def _payload_apply_kernel(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
+                          pf_ref, bits_donor_ref, acc_ref, bits_ref):
+    _payload_apply_body(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
+                        pf_ref, bits_donor_ref, acc_ref, bits_ref, None)
+
+
+def _dgc_apply_kernel(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
+                      pf_ref, bits_donor_ref, acc_ref, bits_ref, *,
+                      divisor):
+    _payload_apply_body(pc_ref, first_ref, cnt_ref, pv_ref, po_ref,
+                        pf_ref, bits_donor_ref, acc_ref, bits_ref, divisor)
+
+
+def _stage_payload(values, indices, flags, total: int):
+    """Payload-scale pre-bucketing shared by :func:`payload_apply_bits`
+    and :func:`dgc_apply_rows` (plain XLA: one sort + cumsum + one
+    payload-sized staging scatter — op-for-op the original epilogue
+    staging, so the unfused program stays byte-identical). Returns the
+    scalar-prefetch maps, the staged [npages, _APPLY_PAGE] operands, and
+    ``npages``."""
+    n = values.shape[0]
+    nchunks = -(-total // _APPLY_CHUNK)
+    pg = _APPLY_PAGE
+    npages_data = -(-n // pg)
+    npages = npages_data + nchunks          # static capacity bound
+    order = jnp.argsort(indices)
+    si = jnp.take(indices, order)
+    sv = jnp.take(values, order)
+    sf = jnp.take(flags, order).astype(jnp.int32)
+    ch = (si // _APPLY_CHUNK).astype(jnp.int32)
+    off = (si - ch.astype(si.dtype) * _APPLY_CHUNK).astype(jnp.int32)
+    starts = jnp.searchsorted(
+        ch, jnp.arange(nchunks, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)                                     # [nchunks]
+    counts = jnp.diff(jnp.concatenate(
+        [starts, jnp.full((1,), n, jnp.int32)]))
+    # every chunk owns >= 1 page (possibly empty) so every output block
+    # is visited and zero-initialized — correctness does not depend on
+    # the donor's contents
+    pages_per = jnp.maximum(-(-counts // pg), 1)
+    page_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pages_per)])  # pages
+    pos = page_start[ch] * pg + (jnp.arange(n, dtype=jnp.int32)
+                                 - starts[ch])
+    cap = npages * pg
+    stage_v = jnp.zeros((cap,), values.dtype).at[pos].set(sv)
+    stage_o = jnp.zeros((cap,), jnp.int32).at[pos].set(off)
+    stage_f = jnp.zeros((cap,), jnp.int32).at[pos].set(sf)
+    pageid = jnp.arange(npages, dtype=jnp.int32)
+    page_chunk = jnp.clip(
+        jnp.searchsorted(page_start, pageid, side="right").astype(
+            jnp.int32) - 1, 0, nchunks - 1)
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (page_chunk[1:] != page_chunk[:-1]).astype(jnp.int32)])
+    pcount = jnp.clip(
+        counts[page_chunk] - (pageid - page_start[page_chunk]) * pg,
+        0, pg)
+    return (page_chunk, first, pcount,
+            stage_v.reshape(npages, pg), stage_o.reshape(npages, pg),
+            stage_f.reshape(npages, pg), npages)
 
 
 @_trace.phased("apply")
@@ -1197,50 +1671,24 @@ def payload_apply_bits(values, indices, flags, total: int,
     unspecified scatter order — equal to f32 rounding. f32 values only
     (the engine gates). Returns ``(acc [total], bits
     [num_sent_words(total)])``."""
+    return _payload_apply_call(_payload_apply_kernel, values, indices,
+                               flags, total, bits_donor)
+
+
+def _payload_apply_call(kernel, values, indices, flags, total: int,
+                        bits_donor):
+    """Shared staging + launch of the apply-epilogue kernels
+    (:func:`payload_apply_bits` and :func:`dgc_apply_rows` differ only
+    in the kernel body's static divisor)."""
     n = values.shape[0]
     assert total % _LANE == 0, total
     assert indices.shape == (n,) and flags.shape == (n,)
     assert values.dtype == jnp.float32, values.dtype
-    nchunks = -(-total // _APPLY_CHUNK)
     pg = _APPLY_PAGE
-    npages_data = -(-n // pg)
-    npages = npages_data + nchunks          # static capacity bound
     brows = num_sent_words(total) // _LANE
 
-    # ---- payload-scale pre-bucketing (plain XLA) ----
-    order = jnp.argsort(indices)
-    si = jnp.take(indices, order)
-    sv = jnp.take(values, order)
-    sf = jnp.take(flags, order).astype(jnp.int32)
-    ch = (si // _APPLY_CHUNK).astype(jnp.int32)
-    off = (si - ch.astype(si.dtype) * _APPLY_CHUNK).astype(jnp.int32)
-    starts = jnp.searchsorted(
-        ch, jnp.arange(nchunks, dtype=jnp.int32), side="left"
-    ).astype(jnp.int32)                                     # [nchunks]
-    counts = jnp.diff(jnp.concatenate(
-        [starts, jnp.full((1,), n, jnp.int32)]))
-    # every chunk owns >= 1 page (possibly empty) so every output block
-    # is visited and zero-initialized — correctness does not depend on
-    # the donor's contents
-    pages_per = jnp.maximum(-(-counts // pg), 1)
-    page_start = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pages_per)])  # pages
-    pos = page_start[ch] * pg + (jnp.arange(n, dtype=jnp.int32)
-                                 - starts[ch])
-    cap = npages * pg
-    stage_v = jnp.zeros((cap,), values.dtype).at[pos].set(sv)
-    stage_o = jnp.zeros((cap,), jnp.int32).at[pos].set(off)
-    stage_f = jnp.zeros((cap,), jnp.int32).at[pos].set(sf)
-    pageid = jnp.arange(npages, dtype=jnp.int32)
-    page_chunk = jnp.clip(
-        jnp.searchsorted(page_start, pageid, side="right").astype(
-            jnp.int32) - 1, 0, nchunks - 1)
-    first = jnp.concatenate(
-        [jnp.ones((1,), jnp.int32),
-         (page_chunk[1:] != page_chunk[:-1]).astype(jnp.int32)])
-    pcount = jnp.clip(
-        counts[page_chunk] - (pageid - page_start[page_chunk]) * pg,
-        0, pg)
+    (page_chunk, first, pcount, stage_v, stage_o, stage_f,
+     npages) = _stage_payload(values, indices, flags, total)
 
     if bits_donor is None:
         bits_donor = jnp.zeros((brows, _LANE), jnp.int32)
@@ -1269,7 +1717,7 @@ def payload_apply_bits(values, indices, flags, total: int,
         ),
     )
     acc, bits = pl.pallas_call(
-        _payload_apply_kernel,
+        kernel,
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct((total // _LANE, _LANE),
                                         values.dtype),
@@ -1277,10 +1725,44 @@ def payload_apply_bits(values, indices, flags, total: int,
         # the dead previous-step record is rebuilt in place
         input_output_aliases={6: 1},
         interpret=_interpret(),
-    )(page_chunk, first, pcount,
-      stage_v.reshape(npages, pg), stage_o.reshape(npages, pg),
-      stage_f.reshape(npages, pg), bits_donor)
+    )(page_chunk, first, pcount, stage_v, stage_o, stage_f, bits_donor)
     return acc.reshape(-1), bits.reshape(-1)
+
+
+def dgc_apply_rows_reference(values, indices, flags, total: int,
+                             divisor=None):
+    """jnp reference of :func:`dgc_apply_rows`: divide the wire by the
+    worker count, then the unfused scatter-add + transmit-record
+    epilogue (:func:`payload_apply_bits_reference`)."""
+    if divisor is not None:
+        values = values / jnp.asarray(divisor, values.dtype)
+    return payload_apply_bits_reference(values, indices, flags, total)
+
+
+@_trace.phased("apply")
+def dgc_apply_rows(values, indices, flags, total: int, bits_donor=None,
+                   divisor=None):
+    """Apply megakernel: unpack → decompress → scatter-apply → sent-bits
+    record in ONE streamed pass — :func:`payload_apply_bits` with the
+    worker-average divide folded into the kernel body, finishing what
+    that epilogue started. The unfused path materializes the divided
+    wire (`wire / world_size`, a [W * payload] intermediate) before the
+    scatter; here each staged entry divides in SMEM-register on its way
+    into the VMEM-resident output block, so the divided wire never
+    exists in HBM.
+
+    ``divisor`` is static (None = sum semantics, no divide traced —
+    byte-identical to :func:`payload_apply_bits`). Per-entry IEEE
+    division by the same f32 operand makes the applied values bitwise
+    the unfused path's. Same staging, same double-buffered
+    scalar-prefetch streaming, same donor aliasing; returns ``(acc
+    [total], bits [num_sent_words(total)])`` bitwise
+    :func:`dgc_apply_rows_reference` under unique real indices."""
+    if divisor is not None:
+        divisor = float(divisor)  # dgclint: ok[host-sync] — static by contract (the engine passes the Python world size), never a tracer
+    return _payload_apply_call(
+        functools.partial(_dgc_apply_kernel, divisor=divisor),
+        values, indices, flags, total, bits_donor)
 
 
 # ------------------------------------------------------------------ #

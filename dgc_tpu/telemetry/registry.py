@@ -172,6 +172,11 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
                "median full train-step wall clock", better="lower"),
     MetricSpec("overhead_ms", "scalar",
                "paired DGC-minus-dense per-step overhead", better="lower"),
+    MetricSpec("overhead_ms_megakernel", "scalar",
+               "paired megakernel-minus-plain per-step delta from the "
+               "DGC_MEGAKERNEL_AB=1 bench arm (negative = the two-"
+               "megakernel hot path is faster); regress-gated so the "
+               "fused path may only get cheaper", better="lower"),
     MetricSpec("exchange_ms", "scalar",
                "modeled sparse exchange time on the reference fabric",
                better="lower"),

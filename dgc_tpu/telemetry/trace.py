@@ -50,8 +50,9 @@ __all__ = ["PHASES", "SCOPE_PREFIX", "enabled", "enable", "phase",
 
 #: canonical DGC phase vocabulary (attrib's table rows come out in this
 #: order; unknown tokens still aggregate — the list is not a gate)
-PHASES = ("compensate", "threshold", "select", "pack", "allgather",
-          "decode", "apply", "dense", "fwd_bwd", "update", "loss")
+PHASES = ("compensate", "forward", "threshold", "select", "pack",
+          "allgather", "decode", "apply", "dense", "fwd_bwd", "update",
+          "loss")
 
 #: named-scope token prefix: scopes are ``dgcph.<phase>`` or
 #: ``dgcph.<phase>.b<bucket>`` — dots, not slashes, so one scope stays

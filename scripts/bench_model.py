@@ -49,6 +49,16 @@ def main():
                          "arms build the model with dtype=bf16 and the "
                          "step casts the flat parameter buffer once "
                          "(build_train_step model_dtype)")
+    ap.add_argument("--megakernel-ab", action="store_true",
+                    help="pair dgc+megakernel against plain dgc instead "
+                         "of dgc vs dense: measures the two-megakernel "
+                         "hot path's step-time delta (DGCCompressor "
+                         "megakernel=True — kernels.dgc_forward_rows + "
+                         "dgc_apply_rows; negative = the fused path "
+                         "wins). Gated as overhead_ms_megakernel.")
+    ap.add_argument("--megakernel", action="store_true",
+                    help="run the DGC arm with megakernel=True in the "
+                         "ordinary dgc-vs-dense pairing")
     ap.add_argument("--telemetry-ab", action="store_true",
                     help="pair dgc+telemetry against plain dgc instead of "
                          "dgc vs dense: measures the in-graph telemetry "
@@ -145,20 +155,27 @@ def main():
                                         consume_metrics=consume))
         return (loop, state), setup
 
-    def mk_comp(checksum=False):
+    def mk_comp(checksum=False, megakernel=None):
+        if megakernel is None:
+            megakernel = args.megakernel
         c = DGCCompressor(args.ratio, memory=DGCSGDMemory(
             momentum=0.9, dtype=args.mem_dtype), int8_values=args.int8,
             int8_error_feedback=not args.no_int8_ef,
-            fused_apply=args.fused_apply, checksum=checksum)
+            fused_apply=args.fused_apply, megakernel=megakernel,
+            checksum=checksum)
         c.initialize((n, p) for n, p in named.items() if p.ndim > 1)
         return c
 
-    def mk_dgc_dist(checksum=False):
+    def mk_dgc_dist(checksum=False, megakernel=None):
         return DistributedOptimizer(
             dgc_sgd(0.1, momentum=0.9, weight_decay=1e-4),
-            mk_comp(checksum), world_size=W)
+            mk_comp(checksum, megakernel=megakernel), world_size=W)
 
-    if args.telemetry_ab:
+    if args.megakernel_ab:
+        a_run, setup = prepare(mk_dgc_dist(megakernel=True))
+        b_run, _ = prepare(mk_dgc_dist(megakernel=False))
+        label = ("dgc+megakernel", "dgc")
+    elif args.telemetry_ab:
         a_run, setup = prepare(mk_dgc_dist(), telemetry=True, consume=True)
         b_run, _ = prepare(mk_dgc_dist(), telemetry=False, consume=True)
         label = ("dgc+telemetry", "dgc")
@@ -246,14 +263,19 @@ def main():
                            static=dict(setup.engine.telemetry_static(),
                                        model=args.model, mode=args.mode,
                                        arms=list(label))) as sk:
-            sk.write_record({
+            rec = {
                 "event": "run_summary",
                 "step_time_ms": round(a_ms, 4),
                 "baseline_step_ms": round(b_ms, 4),
                 "overhead_ms": round(max(med, 0.0), 4),
                 "wire_bytes": setup.engine.wire_bytes_per_worker(),
                 "payload_elems": setup.engine.payload_size,
-            })
+            }
+            if args.megakernel_ab:
+                # signed: a faster megakernel arm must KEEP the gain
+                # under the lower-is-better regression gate
+                rec["overhead_ms_megakernel"] = round(med, 4)
+            sk.write_record(rec)
         print(f"telemetry run written: {args.telemetry_out}",
               file=sys.stderr)
 

@@ -51,6 +51,14 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
   "tests/test_cli.py::test_cli_autotune_two_epoch_replan" \
   -q -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "AUTOTUNE_SMOKE=ok" || { echo "AUTOTUNE_SMOKE=FAIL"; rc=1; }
+# megakernel smoke (docs/PLANNER.md §Megakernels): the two-pass hot path —
+# forward (compensate->select->pack) and apply (unpack->divide->scatter->
+# bits) kernel oracles against their jitted references, the k>128
+# non-delegation pin, and the W=8 engine-level bitwise parity of
+# DGCCompressor(megakernel=True) against the default unfused engine
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_megakernel.py \
+  -q -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "MEGAKERNEL_SMOKE=ok" || { echo "MEGAKERNEL_SMOKE=FAIL"; rc=1; }
 # fleet monitor smoke (docs/TELEMETRY.md §Fleet monitoring): registry fleet
 # schema, the packed in-graph gather's straggler verdict, tolerant shard
 # readers + multi-host merge, rolling-band desync detector, and the

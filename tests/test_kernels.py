@@ -405,20 +405,23 @@ def test_select_pack_rows_bf16_values():
                                   np.asarray(v_ref.astype(jnp.float32)))
 
 
-def test_select_pack_rows_delegates_large():
-    """Shapes past the VMEM budget or k > lane width must fall back to the
-    reference path and stay exact."""
-    from dgc_tpu.ops.kernels import (select_pack_rows,
+def test_select_pack_rows_large_k_stays_exact():
+    """k past the lane width routes to the chunked multi-round kernel
+    (NOT the reference — tests/test_megakernel.py asserts the
+    non-delegation); past _MR_MAX_K the reference takes over. Both
+    regimes stay exact."""
+    from dgc_tpu.ops.kernels import (_MR_MAX_K, select_pack_rows,
                                      select_pack_rows_reference)
 
     rng = np.random.RandomState(5)
     x = jnp.asarray(rng.randn(2, 2048), jnp.float32)
     numels = jnp.asarray([2048, 1500], jnp.int32)
-    s, v, i = select_pack_rows(x, numels, 200)    # k > 128 lane width
-    s_ref, v_ref, i_ref = select_pack_rows_reference(x, numels, 200)
-    np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
-    np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
-    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+    for k in (200, _MR_MAX_K + 1):
+        s, v, i = select_pack_rows(x, numels, k)
+        s_ref, v_ref, i_ref = select_pack_rows_reference(x, numels, k)
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(v_ref))
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
