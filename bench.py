@@ -561,6 +561,21 @@ def main():
               f"replans {tuner.replan_count}",
               file=sys.stderr)
 
+    # --- serving delta stream (ISSUE 17): modeled artifact bytes of one
+    #     published top-k sparse param delta at the same DGC ratio (per-
+    #     row f32 scales + packed int4 values + Elias-Fano index words),
+    #     vs shipping a full f32 checkpoint per update. Static layout
+    #     accounting (dgc_tpu.serving.DeltaSpec) — exact wire sizes, no
+    #     timing, so the row is deterministic and regress-gateable.
+    from dgc_tpu.serving import DeltaSpec
+    sspec = DeltaSpec.from_params({n: np.asarray(p) for n, p in
+                                   named.items()}, 0.001)
+    sdesc = sspec.describe()
+    print(f"[serving delta 0.001] {sdesc['wire_bytes_per_update']} B/update"
+          f" vs full ckpt {sdesc['full_checkpoint_bytes']} B "
+          f"({100 * sdesc['wire_frac']:.2f}%), "
+          f"{sdesc['bits_per_index']:.2f} bits/index", file=sys.stderr)
+
     # spread of the paired per-round overhead: the recorded artifact must
     # carry the distribution, not one session's draw
     q1, q3 = (float(x) for x in np.percentile(diffs, [25, 75]))
@@ -613,6 +628,14 @@ def main():
             "dgc_ms": round(pk_dgc, 5),
             "ratio": round(pk_dense / pk_dgc, 3)},
         "planned": planned,
+        "serving": {
+            "ratio": 0.001,
+            "wire_bytes_per_update": sdesc["wire_bytes_per_update"],
+            "full_checkpoint_bytes": sdesc["full_checkpoint_bytes"],
+            "wire_frac": sdesc["wire_frac"],
+            "bits_per_index": sdesc["bits_per_index"],
+            "payload": sdesc["payload"],
+        },
     }))
 
 

@@ -21,7 +21,7 @@ from dgc_tpu.control.supervisor import Supervisor, parse_env_file
 
 __all__ = ["publish_env", "default_cohort_planner", "act_restart",
            "act_elastic_relaunch", "act_quarantine", "act_adapt",
-           "act_excise", "act_readmit", "ACTIONS", "execute"]
+           "act_excise", "act_readmit", "act_resync", "ACTIONS", "execute"]
 
 
 def publish_env(path: str, updates: Dict[str, str]) -> Dict[str, str]:
@@ -201,6 +201,32 @@ def act_readmit(sup: Supervisor, evidence: Dict,
     return result
 
 
+def act_resync(sup: Optional[Supervisor], evidence: Dict,
+               serving_dir: Optional[str] = None, **_kw) -> Dict:
+    """Ask the run's serving exporter to rebase (dgc_tpu.serving): write
+    the atomic ``resync.json`` request into the stream's serving dir —
+    the exporter consumes it at its next publish, writes a fresh full
+    base snapshot as version+1, and every replica reloads from it. Works
+    without a live Supervisor (the serving population is files, not a
+    child process); when none is passed the serving dir must be."""
+    from dgc_tpu.serving import protocol as _sproto
+    if serving_dir is None and sup is not None and sup.watch:
+        # the conventional layout: the stream lives beside the run the
+        # supervisor watches (<run>/serving)
+        cand = os.path.join(os.path.dirname(os.path.abspath(sup.watch)),
+                            "serving")
+        if os.path.isfile(os.path.join(cand, _sproto.MANIFEST)):
+            serving_dir = cand
+    if serving_dir is None:
+        return {"requested": False, "error": "no serving dir resolvable"}
+    req = _sproto.request_resync(
+        serving_dir, evidence.get("kind", "stale_replica"),
+        replicas=evidence.get("replicas"),
+        fired_by="control_plane", hits=evidence.get("hits"))
+    return {"requested": True, "serving_dir": serving_dir,
+            "request": req}
+
+
 #: action name (registry.CONTROL_ACTIONS) -> implementation
 ACTIONS = {
     "restart": act_restart,
@@ -209,6 +235,7 @@ ACTIONS = {
     "adapt": act_adapt,
     "excise": act_excise,
     "readmit": act_readmit,
+    "resync": act_resync,
 }
 
 

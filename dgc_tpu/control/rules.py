@@ -33,7 +33,7 @@ from typing import Callable, Dict, NamedTuple, Optional, Tuple
 __all__ = ["Rule", "RuleEngine", "default_rules", "load_rules",
            "DETECTORS", "detect_desync", "detect_straggler",
            "detect_quarantine", "detect_cohort_shrink", "detect_excise",
-           "detect_readmit"]
+           "detect_readmit", "detect_stale_replica"]
 
 
 class Rule(NamedTuple):
@@ -178,6 +178,31 @@ def detect_readmit(snap: Dict) -> Optional[Dict]:
     return ev
 
 
+def detect_stale_replica(snap: Dict) -> Optional[Dict]:
+    """A serving replica is unhealthy or past the stream's pinned
+    ``max_lag`` bound (the monitor's serving lane,
+    :func:`dgc_tpu.telemetry.fleet.serving_summary`) — it is serving a
+    model the trainer has moved past, or it hit a gap/divergence the
+    in-place delta path cannot repair. Remediation: ``resync`` — ask the
+    exporter to rebase so the replica reloads a fresh full snapshot."""
+    serving = snap.get("serving") or {}
+    stale = serving.get("stale_replicas") or []
+    if not stale:
+        return None
+    head = serving.get("head") or {}
+    ev: Dict = {"kind": "stale_replica", "replicas": list(stale),
+                "head": f"v{head.get('base_version')}:"
+                        f"{head.get('latest_seq')}",
+                "max_lag": head.get("max_lag")}
+    recs = serving.get("replicas") or {}
+    healths = {n: recs[n].get("health") for n in stale if n in recs}
+    if healths:
+        ev["health"] = healths
+    if "max_staleness" in serving:
+        ev["max_staleness"] = serving["max_staleness"]
+    return ev
+
+
 def default_rules() -> Tuple[Rule, ...]:
     """The shipped remediation table (docs/TELEMETRY.md §"Control plane").
     Order matters: quarantine outranks everything — a numerically dead
@@ -195,6 +220,8 @@ def default_rules() -> Tuple[Rule, ...]:
              min_hits=1, debounce_s=60.0, budget=2),
         Rule("probe-readmit", detect_readmit, "readmit",
              min_hits=1, debounce_s=60.0, budget=2),
+        Rule("stale-replica-resync", detect_stale_replica, "resync",
+             min_hits=2, debounce_s=30.0, budget=4),
     )
 
 
@@ -206,6 +233,7 @@ DETECTORS: Dict[str, Callable[[Dict], Optional[Dict]]] = {
     "cohort_shrink": detect_cohort_shrink,
     "excise": detect_excise,
     "readmit": detect_readmit,
+    "stale_replica": detect_stale_replica,
 }
 
 #: the Rule fields a ``rules.toml`` table may set

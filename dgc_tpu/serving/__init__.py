@@ -1,0 +1,42 @@
+"""dgc_tpu.serving — sparse model-delta streaming from trainer to replicas.
+
+Under DGC the per-N-step parameter delta is top-k sparse by construction,
+so the training stack's wire codecs (int4 values, Elias-Fano delta
+indices — :mod:`dgc_tpu.compression.wirecodec`) ship model updates to a
+serving fleet at a tiny fraction of full-checkpoint bytes. The subsystem
+has three parts (docs/SERVING.md):
+
+* :class:`~dgc_tpu.serving.delta.DeltaSpec` — the static delta format:
+  flat-engine bucketing (:class:`~dgc_tpu.compression.flat.ParamLayout`)
+  over the WHOLE param tree, per-row top-k quotas, int4 values + per-row
+  f32 scales + Elias-Fano indices, and the deterministic scatter apply
+  both ends share (bitwise apply parity).
+* :class:`~dgc_tpu.serving.exporter.Exporter` — trainer side: every N
+  steps, diff current params against the last *published* (decoded)
+  state, encode, publish a versioned delta artifact; full base snapshots
+  carry the checkpoint-lineage anchor; rebases answer resync requests.
+* :class:`~dgc_tpu.serving.replica.Replica` — serving side: applies
+  deltas in place, tracks ``(base_version, delta_seq)``, reports
+  staleness/gap health the fleet monitor scrapes, and falls back to
+  full-snapshot resync on a gap or a staleness-bound breach (self-driven
+  with ``auto_resync=True``, else via the control plane's
+  ``stale_replica -> resync`` rule).
+
+Everything here is host-side file-protocol code (atomic publishes, JSON
+manifests) — nothing imports into the train step, and the codecs reuse
+the exact compression-stack implementations.
+"""
+
+from dgc_tpu.serving.delta import DeltaSpec
+from dgc_tpu.serving.exporter import Exporter
+from dgc_tpu.serving.protocol import (
+    MANIFEST, RESYNC_REQUEST, clear_resync_request, read_manifest,
+    read_resync_request, request_resync, write_json_atomic,
+)
+from dgc_tpu.serving.replica import Replica
+
+__all__ = [
+    "DeltaSpec", "Exporter", "Replica", "MANIFEST", "RESYNC_REQUEST",
+    "read_manifest", "read_resync_request", "request_resync",
+    "clear_resync_request", "write_json_atomic",
+]

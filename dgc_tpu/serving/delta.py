@@ -1,0 +1,298 @@
+"""The serving delta format: bucketed top-k sparse param deltas on the
+training stack's wire codecs.
+
+A :class:`DeltaSpec` is the static contract both ends of the stream agree
+on. It is built from nothing but the parameter ``{name: shape}`` map and
+the serving ratio, so a replica reconstructs the identical spec from the
+manifest without ever seeing the trainer's process:
+
+* **bucketing** — :class:`~dgc_tpu.compression.flat.ParamLayout` over the
+  WHOLE tree (every tensor is delta-compressed, down to scalars; the
+  layout's size-bucket DP and row-aligned tiles are reused unchanged),
+  then one :func:`~dgc_tpu.compression.flat._bucket_from_rows` bucket per
+  layout tile with per-row quotas ``k_r = max(1, round(numel_r * ratio))``.
+* **indices** — :class:`~dgc_tpu.compression.wirecodec.DeltaIndexCodec`
+  (Elias-Fano over the canonically sorted stream). Selection is emitted
+  sorted ascending per row with the pad tail clipped in-row, which
+  satisfies the codec's sorted-per-bucket contract by construction.
+* **values** — int4 nibbles (:func:`~dgc_tpu.compression.wirecodec.pack_int4`)
+  against one f32 scale per bucket row (``scale_r = max|v| / 7``); padded
+  slots quantize to exactly 0 and scatter as no-ops anywhere, the same
+  zero-contribution contract the training scatter sentinel rides.
+
+**Bitwise apply parity**: :meth:`DeltaSpec.apply` is a deterministic
+host-side ``decode -> dequantize -> np.add.at`` over the flat f32 buffer.
+The exporter advances its published state by applying its own DECODED
+artifacts — never the raw delta — so a replica that applied the same
+artifact stream holds the byte-identical flat buffer, checkable by
+digest at any ``(base_version, delta_seq)``. Quantization error and the
+unsent tail are *not* lost: they stay in the live-params-minus-published
+difference and ride the next delta (the serving analogue of DGC's error
+feedback).
+"""
+
+import hashlib
+import json
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from dgc_tpu.compression.flat import ParamLayout, _bucket_from_rows
+from dgc_tpu.compression.wirecodec import (
+    DeltaIndexCodec, pack_int4, unpack_int4)
+
+__all__ = ["DeltaSpec"]
+
+#: artifact format tag, bumped on any incompatible wire-layout change
+FORMAT = "dgc-serving-delta"
+FORMAT_VERSION = 1
+
+
+def _named_arrays(params) -> Dict[str, np.ndarray]:
+    """Any param container (pytree, flax variables dict, {name: array})
+    -> an ordered {name: f32 ndarray} map."""
+    from dgc_tpu.utils.pytree import named_flatten
+    named, _ = named_flatten(params)
+    return {n: np.asarray(a, np.float32) for n, a in named.items()}
+
+
+class DeltaSpec:
+    """Static codec + layout for one parameter set at one serving ratio."""
+
+    def __init__(self, shapes: Dict[str, Sequence[int]], ratio: float):
+        if not shapes:
+            raise ValueError("DeltaSpec needs at least one parameter")
+        if not (0.0 < float(ratio) <= 1.0):
+            raise ValueError(f"serving ratio must be in (0, 1], got {ratio}")
+        self.ratio = float(ratio)
+        self.shapes = {str(n): tuple(int(d) for d in shapes[n])
+                       for n in shapes}
+        elems = sum(int(np.prod(np.asarray(s, np.int64)))
+                    for s in self.shapes.values())
+        if elems >= 2 ** 31:
+            # cheap pre-check before materializing the layout template;
+            # the layout.total guard below covers padding-driven overflow
+            raise ValueError(
+                f"serving layout spans {elems} >= 2^31 slots — "
+                "shard the stream per parameter group")
+        template = {n: np.zeros(s, np.float32)
+                    for n, s in self.shapes.items()}
+        #: the flat-engine layout, every tensor in the compressed block
+        self.layout = ParamLayout(template, compressed_names=list(template))
+        if self.layout.total >= 2 ** 31:
+            # index traffic rides int32 (the codecs' own decode bound);
+            # a >2^31-slot serving state needs per-shard streams anyway
+            raise ValueError(
+                f"serving layout spans {self.layout.total} >= 2^31 slots — "
+                "shard the stream per parameter group")
+        self.buckets = []
+        for g in self.layout.buckets:
+            rows = []
+            for n in g.names:
+                numel = self.layout.sizes[n]
+                k = max(1, min(numel, int(round(numel * self.ratio))))
+                # stride/sample/topk attrs are selection-pipeline fields
+                # the wire codecs never read; fill with the exact-sampling
+                # identity so the bucket is self-consistent
+                rows.append((self.layout.offsets[n], numel, 1, numel, k, k))
+            self.buckets.append(_bucket_from_rows(g.base, g.cols, rows))
+        self.codec = DeltaIndexCodec(self.buckets)
+        self.payload = self.codec.payload
+        #: per payload slot: index of its owning row in the concatenated
+        #: per-row scale vector (bucket-major, row-minor)
+        slot_scale, self.num_rows = [], 0
+        for b in self.buckets:
+            rows = np.asarray(b.tight) // b.max_sel
+            slot_scale.append(self.num_rows + rows.astype(np.int64))
+            self.num_rows += b.rows
+        self._slot_scale = np.concatenate(slot_scale)
+        self._slot_off = np.asarray(self.codec.slot_off, np.int64)
+        self._slot_numel = np.asarray(self.codec.slot_numel, np.int64)
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_params(cls, params, ratio: float) -> "DeltaSpec":
+        return cls({n: a.shape for n, a in _named_arrays(params).items()},
+                   ratio)
+
+    def meta(self) -> Dict:
+        """The JSON-able spec record a manifest carries; feeding it back
+        through :meth:`from_meta` reconstructs the identical spec."""
+        return {"format": FORMAT, "format_version": FORMAT_VERSION,
+                "ratio": self.ratio,
+                "shapes": {n: list(s) for n, s in self.shapes.items()},
+                "key": self.key()}
+
+    @classmethod
+    def from_meta(cls, meta: Dict) -> "DeltaSpec":
+        if meta.get("format") != FORMAT:
+            raise ValueError(f"not a serving delta spec: "
+                             f"format={meta.get('format')!r}")
+        if int(meta.get("format_version", -1)) != FORMAT_VERSION:
+            raise ValueError(
+                f"serving delta format version {meta.get('format_version')} "
+                f"!= supported {FORMAT_VERSION} — resync from a full "
+                "checkpoint written by a matching tree")
+        spec = cls(meta["shapes"], float(meta["ratio"]))
+        if meta.get("key") and meta["key"] != spec.key():
+            raise ValueError("serving spec key mismatch: the manifest was "
+                             "published by a different layout/codec build")
+        return spec
+
+    def key(self) -> str:
+        """Content hash of everything the wire layout depends on — the
+        lineage anchor's compatibility check."""
+        h = hashlib.sha256()
+        h.update(json.dumps(
+            {"format": FORMAT, "v": FORMAT_VERSION, "ratio": self.ratio,
+             "shapes": {n: list(s) for n, s in sorted(self.shapes.items())}},
+            sort_keys=True).encode())
+        return h.hexdigest()[:16]
+
+    # ------------------------------------------------------------------ #
+
+    def flatten(self, params) -> np.ndarray:
+        """Params -> flat f32 [total] in layout order (host-side numpy;
+        structural zeros in row tails / gaps, like ``ParamLayout.flatten``)."""
+        named = _named_arrays(params)
+        got = {n: tuple(a.shape) for n, a in named.items()}
+        if got != self.shapes:
+            raise ValueError(
+                f"params do not match the serving spec: spec shapes "
+                f"{self.shapes} vs got {got}")
+        flat = np.zeros((self.layout.total,), np.float32)
+        for n, a in named.items():
+            off = self.layout.offsets[n]
+            flat[off:off + self.layout.sizes[n]] = np.ravel(a)
+        return flat
+
+    def unflatten(self, flat: np.ndarray) -> Dict[str, np.ndarray]:
+        """Flat [total] -> {name: array} (the replica's serving view)."""
+        out = {}
+        for n, shape in self.shapes.items():
+            off = self.layout.offsets[n]
+            out[n] = np.asarray(flat[off:off + self.layout.sizes[n]]
+                                ).reshape(shape)
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    def encode(self, delta: np.ndarray) -> Dict[str, np.ndarray]:
+        """Flat f32 delta [total] -> wire artifact arrays.
+
+        Per bucket row: top-``k_r`` by |delta|, indices sorted ascending,
+        pad tail clipped to the row's last element with value exactly 0.0
+        (the codec's canonical form), then int4 quantize against the
+        row's scale. Returns ``{"scales" f32 [num_rows], "values" int8
+        [ceil(payload/2)], "words" uint32 [nwords]}``.
+        """
+        delta = np.asarray(delta, np.float32)
+        if delta.shape != (self.layout.total,):
+            raise ValueError(f"delta shape {delta.shape} != "
+                             f"({self.layout.total},)")
+        values = np.zeros((self.payload,), np.float32)
+        indices = np.zeros((self.payload,), np.int64)
+        scales = np.ones((self.num_rows,), np.float32)
+        p0 = row0 = 0
+        for b in self.buckets:
+            grid_v = np.zeros((b.rows, b.max_sel), np.float32)
+            # pad slots carry the row's last element (in-row, ascending
+            # after any real selection) with value 0.0 — decodes as a
+            # zero-contribution scatter, same envelope as the sentinel
+            grid_i = np.repeat((np.asarray(b.row_offsets, np.int64)
+                                + np.asarray(b.numels, np.int64) - 1)
+                               [:, None], b.max_sel, axis=1)
+            for r in range(b.rows):
+                off = int(b.row_offsets[r])
+                numel = int(b.numels[r])
+                k = int(b.num_selects[r])
+                x = delta[off:off + numel]
+                if k < numel:
+                    sel = np.argpartition(np.abs(x), numel - k)[numel - k:]
+                else:
+                    sel = np.arange(numel)
+                sel = np.sort(sel)
+                grid_v[r, :k] = x[sel]
+                grid_i[r, :k] = off + sel
+            tight = np.asarray(b.tight)
+            values[p0:p0 + b.payload] = grid_v.reshape(-1)[tight]
+            indices[p0:p0 + b.payload] = grid_i.reshape(-1)[tight]
+            amax = np.max(np.abs(grid_v), axis=1, initial=0.0)
+            scales[row0:row0 + b.rows] = np.where(amax > 0, amax / 7.0, 1.0)
+            p0 += b.payload
+            row0 += b.rows
+        q = np.clip(np.rint(values / scales[self._slot_scale]), -8, 7
+                    ).astype(np.int32)
+        packed = np.asarray(pack_int4(q))
+        # int32 keeps the codec on its native width (no x64 round-trip);
+        # the constructor guards total < 2^31
+        words = np.asarray(self.codec.encode(indices.astype(np.int32)))
+        return {"scales": scales, "values": packed, "words": words}
+
+    def decode(self, artifact: Dict[str, np.ndarray]
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Wire artifact -> (values f32 [payload], indices int64 [payload])
+        — the canonical stream every receiver reconstructs."""
+        q = np.asarray(unpack_int4(
+            np.asarray(artifact["values"], np.int8), self.payload))
+        scales = np.asarray(artifact["scales"], np.float32)
+        if scales.shape != (self.num_rows,):
+            raise ValueError(f"scale lane shape {scales.shape} != "
+                             f"({self.num_rows},)")
+        values = q.astype(np.float32) * scales[self._slot_scale]
+        idx = np.asarray(self.codec.decode(
+            np.asarray(artifact["words"], np.uint32),
+            out_dtype=np.int32)).astype(np.int64)
+        # receiver-side row clamp: a corrupted word decodes in-row, the
+        # same containment the training wire relies on
+        idx = self._slot_off + np.clip(idx - self._slot_off, 0,
+                                       self._slot_numel - 1)
+        return values, idx
+
+    def apply(self, flat: np.ndarray,
+              artifact: Dict[str, np.ndarray]) -> np.ndarray:
+        """One deterministic in-place delta application: scatter-ADD the
+        decoded values at the decoded coordinates. Both ends run exactly
+        this, which is what makes apply parity bitwise."""
+        values, idx = self.decode(artifact)
+        out = np.array(flat, np.float32, copy=True)
+        np.add.at(out, idx, values)
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    def wire_bytes_per_update(self) -> int:
+        """Exact artifact payload bytes of one delta update (scale lane +
+        packed int4 values + Elias-Fano index words)."""
+        return int(4 * self.num_rows + (self.payload + 1) // 2
+                   + 4 * self.codec.nwords)
+
+    def full_checkpoint_bytes(self) -> int:
+        """f32 bytes of a full parameter snapshot — the shipping cost the
+        delta stream replaces."""
+        return int(4 * self.layout.num_params)
+
+    @staticmethod
+    def digest(flat: np.ndarray) -> str:
+        """Content digest of a flat param state — the apply-parity check
+        between the exporter's published state and a replica."""
+        return hashlib.sha256(
+            np.ascontiguousarray(np.asarray(flat, np.float32)).tobytes()
+        ).hexdigest()[:16]
+
+    def describe(self) -> Dict:
+        """Static accounting for logs/bench: payload, rows, wire bytes,
+        bits/index, and the delta:checkpoint byte ratio."""
+        wire = self.wire_bytes_per_update()
+        full = self.full_checkpoint_bytes()
+        return {
+            "num_params": int(self.layout.num_params),
+            "payload": int(self.payload),
+            "num_rows": int(self.num_rows),
+            "num_buckets": len(self.buckets),
+            "bits_per_index": round(self.codec.bits_per_index, 3),
+            "wire_bytes_per_update": wire,
+            "full_checkpoint_bytes": full,
+            "wire_frac": round(wire / full, 6) if full else 0.0,
+        }

@@ -56,6 +56,7 @@ __all__ = [
     "gather_stats", "make_clock", "FleetView", "DesyncAlert",
     "discover_shards", "discover_runs", "load_view", "worker_series",
     "detect_desync", "straggler_table", "fleet_summary",
+    "discover_serving", "serving_summary",
 ]
 
 #: fleet lanes appended to the packed telemetry vector, in order; the
@@ -282,7 +283,8 @@ def discover_runs(fleet_root: str) -> Dict[str, str]:
             # is itself a single run, not a fleet of them
             continue
         if discover_shards(path) or os.path.isfile(
-                os.path.join(path, "supervise_events.jsonl")):
+                os.path.join(path, "supervise_events.jsonl")) \
+                or discover_serving(path):
             out[name] = path
     if not out and discover_shards(fleet_root):
         base = os.path.basename(os.path.normpath(fleet_root)) or "run"
@@ -430,6 +432,60 @@ def straggler_table(view: FleetView, window: int = 50) -> List[Dict]:
     } for i in range(mat.shape[1])]
     rows.sort(key=lambda r: -r["mean_ms"])
     return rows
+
+
+def discover_serving(run: str) -> Optional[str]:
+    """A run's serving-stream directory, when the trainer exports one:
+    ``<run>/serving/`` holding a ``manifest.json`` (dgc_tpu.serving
+    layout), or the run dir itself when pointed straight at a stream."""
+    for cand in (os.path.join(run, "serving"), run):
+        if os.path.isfile(os.path.join(cand, "manifest.json")):
+            return cand
+    return None
+
+
+def serving_summary(serving_dir: str) -> Dict:
+    """One serving-lane rollup: the stream head from ``manifest.json``
+    plus the latest per-replica ``replica_status`` records
+    (``replica_<name>.json`` files the replicas publish beside the
+    stream). Plain file reads — same offline/live/test reach as the rest
+    of the host-side fleet code. Replica records that fail the registry
+    schema are dropped-with-count rather than trusted."""
+    import json
+
+    out: Dict = {"replicas": {}, "bad_status": 0}
+    try:
+        with open(os.path.join(serving_dir, "manifest.json")) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return out
+    out["head"] = {
+        "base_version": int(man.get("base_version", 0)),
+        "latest_seq": int(man.get("latest_seq", 0)),
+        "max_lag": int(man.get("max_lag", 0)),
+        "wire_bytes_per_update": int(man.get("wire_bytes_per_update", 0)),
+        "full_checkpoint_bytes": int(man.get("full_checkpoint_bytes", 0)),
+        "lineage": man.get("lineage", {}),
+    }
+    for path in sorted(_glob.glob(os.path.join(serving_dir,
+                                               "replica_*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            registry.validate_replica_status(rec)
+        except (OSError, json.JSONDecodeError, ValueError):
+            out["bad_status"] += 1
+            continue
+        out["replicas"][str(rec["replica"])] = rec
+    stale = [n for n, r in out["replicas"].items()
+             if r["health"] != "ok" or (
+                 0 <= int(r["max_lag"]) < int(r["staleness"]))]
+    out["stale_replicas"] = sorted(stale)
+    out["num_replicas"] = len(out["replicas"])
+    if out["replicas"]:
+        out["max_staleness"] = max(int(r["staleness"])
+                                   for r in out["replicas"].values())
+    return out
 
 
 def fleet_summary(view: FleetView, *, desync_metrics: Sequence[str] = (

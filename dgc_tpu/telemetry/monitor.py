@@ -147,7 +147,15 @@ def collect(run: str, *, rate_window: int = 50) -> Dict:
     """One monitor snapshot of a run: latest record, derived rates, fleet
     summary, straggler table, guard counters, flight-recorder dump, and
     the trailing events. Pure read."""
-    view = _fleet.load_view(run)
+    serving_dir = _fleet.discover_serving(run)
+    try:
+        view = _fleet.load_view(run)
+    except FileNotFoundError:
+        if serving_dir is None:
+            raise
+        # a serving-only dir (replica fleet with no trainer telemetry
+        # here) is still a monitorable population
+        view = _fleet.FleetView(hosts={}, events=[], header={}, skipped=0)
     steps = view.steps
     last = steps[-1] if steps else {}
     static = view.header.get("static", {})
@@ -219,6 +227,10 @@ def collect(run: str, *, rate_window: int = 50) -> Dict:
                 snap["cohort"] = cohort
         except (OSError, ValueError):
             pass
+    # serving-stream lane: stream head + per-replica staleness/health
+    # (dgc_tpu.serving exporter/replicas publishing under <run>/serving)
+    if serving_dir is not None:
+        snap["serving"] = _fleet.serving_summary(serving_dir)
     sup = read_supervise_events(run)
     if sup:
         snap["supervise_launches"] = max(
@@ -314,6 +326,42 @@ def _snap_samples(snap: Dict, families: Dict) -> None:
         gauge("dgc_supervise_launches",
               "trainer launches recorded by the restart supervisor",
               [(_labels(run), snap["supervise_launches"])])
+    serving = snap.get("serving")
+    if isinstance(serving, dict) and serving.get("head"):
+        head = serving["head"]
+        gauge("dgc_serving_latest_seq",
+              "delta sequence at the serving stream head",
+              [(_labels(run), head.get("latest_seq", 0))])
+        gauge("dgc_serving_base_version",
+              "full base snapshot generation at the stream head",
+              [(_labels(run), head.get("base_version", 0))])
+        gauge("dgc_serving_wire_bytes_per_update",
+              "delta-stream artifact bytes per published update",
+              [(_labels(run), head.get("wire_bytes_per_update", 0))])
+        gauge("dgc_serving_replicas", "replicas reporting on the stream",
+              [(_labels(run), serving.get("num_replicas", 0))])
+        gauge("dgc_serving_stale_replicas",
+              "replicas unhealthy or past the pinned max_lag bound",
+              [(_labels(run), len(serving.get("stale_replicas", [])))])
+        for name_, rec in sorted(serving.get("replicas", {}).items()):
+            lbl = _labels(run, replica=name_)
+            gauge("dgc_replica_staleness",
+                  "delta updates a replica trails the stream head "
+                  "(latest_seq - delta_seq; -1 before the first base)",
+                  [(lbl, rec.get("staleness", -1))])
+            gauge("dgc_replica_healthy",
+                  "1 when the replica's health is 'ok', else 0",
+                  [(lbl, 1 if rec.get("health") == "ok" else 0)])
+            gauge("dgc_replica_delta_seq",
+                  "last delta sequence a replica applied on its base",
+                  [(lbl, rec.get("delta_seq", -1))])
+            gauge("dgc_replica_resyncs",
+                  "cumulative full-snapshot reloads by a replica",
+                  [(lbl, rec.get("resyncs", 0))])
+            gauge("dgc_replica_gaps",
+                  "cumulative missing-artifact gaps a replica detected",
+                  [(lbl, rec.get("gaps", 0))])
+
     cohort = snap.get("cohort")
     if isinstance(cohort, dict):
         size = cohort.get("target") or cohort.get("spec_world")
@@ -488,6 +536,34 @@ def render_status(snap: Dict) -> str:
         if parts:
             lines.append("   COHORT: " + "  ".join(parts))
 
+    serving = snap.get("serving")
+    if isinstance(serving, dict) and serving.get("head"):
+        head = serving["head"]
+        parts = [f"head v{head.get('base_version')}:"
+                 f"{head.get('latest_seq')}",
+                 f"{serving.get('num_replicas', 0)} replicas"]
+        if "max_staleness" in serving:
+            parts.append(f"max staleness {serving['max_staleness']}"
+                         f"/{head.get('max_lag')}")
+        wire = head.get("wire_bytes_per_update")
+        full = head.get("full_checkpoint_bytes")
+        if wire and full:
+            parts.append(f"wire {wire}B/update ({wire / full:.2%} of "
+                         "full ckpt)")
+        stale = serving.get("stale_replicas") or []
+        line = "   SERVING: " + "  ".join(parts)
+        if stale:
+            line += "  STALE=[" + ",".join(stale) + "]"
+        lines.append(line)
+        for name_, rec in sorted(serving.get("replicas", {}).items()):
+            if rec.get("health") != "ok":
+                lines.append(f"     replica {name_}: {rec.get('health')} "
+                             f"@ v{rec.get('base_version')}:"
+                             f"{rec.get('delta_seq')} "
+                             f"(staleness {rec.get('staleness')}, "
+                             f"gaps {rec.get('gaps')}, "
+                             f"resyncs {rec.get('resyncs')})")
+
     if "last_event" in snap:
         lines.append("   last run event:   "
                      + _event_line(snap["last_event"]))
@@ -575,6 +651,10 @@ def rank_runs(fsnap: Dict) -> List[Dict]:
             score -= 15
             notes.append(f"straggler w{summary.get('straggler')} "
                          f"x{share:.2f}")
+        stale = (snap.get("serving") or {}).get("stale_replicas") or []
+        if stale:
+            score -= 25
+            notes.append("stale-replicas [" + ",".join(stale) + "]")
         if not snap.get("steps_per_s") and last_sup.get("event") not in \
                 ("done",):
             score -= 10

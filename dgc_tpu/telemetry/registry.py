@@ -12,11 +12,12 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 __all__ = [
     "SCHEMA", "SCHEMA_VERSION", "MetricSpec", "STEP_METRICS", "RUN_METRICS",
-    "GUARD_METRICS", "FLEET_METRICS", "CONTROL_ACTIONS", "step_stat_names",
-    "guard_stat_names", "fleet_stat_names", "control_action_names",
-    "spec_by_name", "step_out_specs", "guard_out_specs", "fleet_out_specs",
-    "make_header", "validate_step_stats", "validate_guard_stats",
-    "validate_fleet_stats", "validate_control_action",
+    "GUARD_METRICS", "FLEET_METRICS", "CONTROL_ACTIONS", "SERVING_METRICS",
+    "step_stat_names", "guard_stat_names", "fleet_stat_names",
+    "control_action_names", "serving_stat_names", "spec_by_name",
+    "step_out_specs", "guard_out_specs", "fleet_out_specs", "make_header",
+    "validate_step_stats", "validate_guard_stats", "validate_fleet_stats",
+    "validate_control_action", "validate_replica_status",
 ]
 
 #: schema family tag written into every sink header
@@ -163,6 +164,39 @@ CONTROL_ACTIONS: Tuple[MetricSpec, ...] = (
                "grown cohort spec and relaunch it; the elastic 1:k split "
                "reshard re-seats the error-feedback state — frees the "
                "device-pool ledger's quarantine slot", better="lower"),
+    MetricSpec("resync", "action",
+               "ask the serving exporter to rebase: publish resync.json in "
+               "the stream's serving dir so the next publish writes a fresh "
+               "full base snapshot and replicas reload from it — the "
+               "stale/gapped/divergent-replica remediation "
+               "(dgc_tpu.serving)", better="lower"),
+)
+
+#: per-replica serving-stream health (dgc_tpu.serving, ISSUE 17). Each
+#: ``Replica.poll()`` yields one ``replica_status`` record; the fleet
+#: monitor scrapes the latest per replica into ``{replica=…}``-labeled
+#: gauges, and the control plane's ``stale_replica -> resync`` rule reads
+#: them. ADDITIVE, same doctrine as GUARD_METRICS/FLEET_METRICS.
+SERVING_METRICS: Tuple[MetricSpec, ...] = (
+    MetricSpec("staleness", "scalar",
+               "delta updates behind the stream head: latest_seq - "
+               "delta_seq (-1 before the first base load); the pinned "
+               "bound is the manifest's max_lag", better="lower"),
+    MetricSpec("base_version", "scalar",
+               "full base snapshot generation the replica serves from"),
+    MetricSpec("delta_seq", "scalar",
+               "last delta sequence applied on the current base"),
+    MetricSpec("applied_deltas", "scalar",
+               "cumulative delta artifacts applied in place"),
+    MetricSpec("resyncs", "scalar",
+               "cumulative full-snapshot reloads (base changes after the "
+               "first)", better="lower"),
+    MetricSpec("gaps", "scalar",
+               "cumulative missing-artifact gaps detected below the "
+               "stream head", better="lower"),
+    MetricSpec("healthy", "scalar",
+               "1.0 when the replica's health is 'ok', else 0.0 (init/"
+               "no_manifest/no_base/gap/stale/divergent)", better="higher"),
 )
 
 #: run-level summary keys the regression gate compares (step time and
@@ -208,6 +242,12 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
                "slowest worker: max(w_clock) - median(w_clock), ms "
                "(bench.py fleet.straggler_stall_ms) — the quantity the "
                "adaptive exchange exists to shrink", better="lower"),
+    MetricSpec("wire_bytes_per_update", "scalar",
+               "serving delta-stream artifact bytes per published update "
+               "(scales + packed int4 values + Elias-Fano index words) at "
+               "the serving ratio on the ResNet-20 config (bench.py "
+               "serving.wire_bytes_per_update) — vs full_checkpoint_bytes "
+               "shipping", better="lower"),
     MetricSpec("alias_coverage", "scalar",
                "donated-param fraction of the state leaves in the compiled "
                "step's input_output_alias header (dgcver donation pass, "
@@ -235,6 +275,10 @@ def fleet_stat_names() -> Tuple[str, ...]:
 
 def control_action_names() -> Tuple[str, ...]:
     return tuple(s.name for s in CONTROL_ACTIONS)
+
+
+def serving_stat_names() -> Tuple[str, ...]:
+    return tuple(s.name for s in SERVING_METRICS)
 
 
 def spec_by_name() -> Dict[str, MetricSpec]:
@@ -311,6 +355,22 @@ def validate_control_action(record: Dict) -> None:
             f"(known: {list(control_action_names())})")
     if not isinstance(record["evidence"], dict) or not record["evidence"]:
         raise ValueError("control_action evidence must be a non-empty dict")
+
+
+def validate_replica_status(record: Dict) -> None:
+    """Schema check for one serving ``replica_status`` record before the
+    fleet monitor trusts it: who is reporting, where it stands in the
+    stream, and a health verdict."""
+    if record.get("event") != "replica_status":
+        raise ValueError(
+            f"replica_status record has event={record.get('event')!r}")
+    missing = [k for k in ("replica", "base_version", "delta_seq",
+                           "latest_seq", "staleness", "max_lag", "health",
+                           "t") if k not in record]
+    if missing:
+        raise ValueError(f"replica_status record missing keys: {missing}")
+    if not str(record["replica"]):
+        raise ValueError("replica_status needs a non-empty replica name")
 
 
 def make_header(static: Optional[Dict] = None,

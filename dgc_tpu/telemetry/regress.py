@@ -79,6 +79,11 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
         for k in ("worker_skew", "straggler_gap", "straggler_stall_ms"):
             if isinstance(flt.get(k), (int, float)):
                 out[k] = float(flt[k])
+    # serving delta-stream wire accounting (lower is better; see registry)
+    srv = obj.get("serving")
+    if isinstance(srv, dict) and isinstance(
+            srv.get("wire_bytes_per_update"), (int, float)):
+        out["wire_bytes_per_update"] = float(srv["wire_bytes_per_update"])
     return out
 
 

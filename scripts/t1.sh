@@ -100,6 +100,18 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_adaptive.py 
   "tests/test_multiprocess.py::test_fleet_two_process_adaptive" \
   -q -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "ADAPTIVE_SMOKE=ok" || { echo "ADAPTIVE_SMOKE=FAIL"; rc=1; }
+# serving smoke (docs/SERVING.md): DeltaSpec wire path (meta/key pinning,
+# encode/decode/apply parity, error-feedback carryover), the exporter/
+# replica file protocol with gap -> resync -> rebase, the fleet serving
+# lane + stale_replica->resync control rule — and the REAL 1-trainer/
+# 2-replica subprocess drill: delta (1,5) dropped on the wire, the parent
+# control plane fires an audited resync, and both replicas must end
+# bitwise-identical to the trainer's post-rebase head within the pinned
+# staleness bound
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+  tests/test_wirecodec.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "SERVE_SMOKE=ok" || { echo "SERVE_SMOKE=FAIL"; rc=1; }
 # dgcver wall-clock budget (docs/ANALYSIS.md §Verifier): the full verify
 # suite — trace + 4 passes over every pinned config, one donated compile,
 # report emission — must finish inside 60 s on the CPU mesh, so the

@@ -1,0 +1,118 @@
+"""Subprocess worker for the train-to-serve drill (tests/test_serving.py).
+
+Roles (argv[1]):
+
+* ``trainer <serving_dir> <target_version> <target_seq>`` — walks a
+  seeded toy parameter set and publishes the serving stream every tick
+  (``Exporter``); the injected dropped-delta fault rides the
+  ``DGC_SERVE_DROP`` env var set by the test. Stops once the stream head
+  reaches ``(target_version, target_seq)`` — i.e. after the control
+  plane's resync rebase landed and the post-resync stream advanced.
+* ``replica <serving_dir> <name> <target_version> <target_seq>`` —
+  follows the stream (``Replica``, ``auto_resync=False``: the CONTROL
+  PLANE must drive the resync, that is the drill), publishes its status
+  file for the fleet monitor every poll, and exits once it serves
+  exactly the target head.
+
+Prints ``RESULT:<json>`` as the last line; everything else is progress
+logging for the drill's log files (pipes deadlock at 64 KB — the parent
+reads files, tests/test_multiprocess.py pattern).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_params(step: int):
+    """The trainer's deterministic toy model state at ``step``: both ends
+    of the drill can name any step's exact params, so parity failures are
+    attributable. Mixed shapes on purpose (matrix / vector / scalar)."""
+    rng = np.random.RandomState(1234)
+    w = rng.randn(48, 32).astype(np.float32)
+    b = rng.randn(48).astype(np.float32)
+    s = np.float32(0.5)
+    for i in range(step):
+        upd = np.random.RandomState(10_000 + i)
+        w = w + 0.01 * upd.randn(48, 32).astype(np.float32)
+        b = b + 0.01 * upd.randn(48).astype(np.float32)
+        s = np.float32(s + 0.001)
+    return {"w": w, "b": b, "s": s}
+
+
+def run_trainer(serving_dir: str, target_version: int,
+                target_seq: int) -> dict:
+    from dgc_tpu.serving import Exporter
+    exp = Exporter(serving_dir, make_params(0), ratio=0.05, max_lag=3,
+                   lineage={"epoch": 0, "step": 0})
+    step, published = 0, 0
+    deadline = time.monotonic() + 90.0
+    while time.monotonic() < deadline:
+        step += 1
+        rec = exp.publish(make_params(step), step=step)
+        published += 1
+        print(f"published {rec['kind']} v{rec['base_version']}:"
+              f"{rec['delta_seq']}"
+              + (" DROPPED" if rec.get("dropped") else ""), flush=True)
+        if (exp.base_version >= target_version
+                and exp.delta_seq >= target_seq):
+            break
+        time.sleep(0.15)
+    key = f"{exp.base_version}:{exp.delta_seq}"
+    return {"role": "trainer", "base_version": exp.base_version,
+            "latest_seq": exp.delta_seq, "digest": exp.digests[key],
+            "published": published,
+            "wire_bytes_per_update": exp.spec.wire_bytes_per_update(),
+            "full_checkpoint_bytes": exp.spec.full_checkpoint_bytes()}
+
+
+def run_replica(serving_dir: str, name: str, target_version: int,
+                target_seq: int) -> dict:
+    from dgc_tpu.serving import Replica
+    from dgc_tpu.telemetry import registry
+    rep = Replica(serving_dir, name=name, auto_resync=False)
+    max_ok_staleness = 0
+    deadline = time.monotonic() + 90.0
+    st = rep.status(latest_seq=-1, max_lag=0)
+    while time.monotonic() < deadline:
+        st = rep.poll()
+        registry.validate_replica_status(st)
+        rep.write_status(serving_dir, latest_seq=st["latest_seq"],
+                         max_lag=st["max_lag"])
+        if st["health"] == "ok":
+            max_ok_staleness = max(max_ok_staleness, st["staleness"])
+        if (st["health"] == "ok"
+                and st["base_version"] == target_version
+                and st["delta_seq"] == target_seq
+                and st["latest_seq"] == target_seq):
+            break
+        time.sleep(0.1)
+    # bitwise apply parity is checked by the parent against the trainer's
+    # digest for the same (base_version, delta_seq)
+    out = dict(st, role="replica", digest=rep.digest(),
+               max_ok_staleness=max_ok_staleness)
+    # the served params reshape losslessly out of the flat state
+    params = rep.params()
+    out["param_names"] = sorted(params)
+    return out
+
+
+def main(argv) -> int:
+    role = argv[1]
+    if role == "trainer":
+        result = run_trainer(argv[2], int(argv[3]), int(argv[4]))
+    elif role == "replica":
+        result = run_replica(argv[2], argv[3], int(argv[4]), int(argv[5]))
+    else:
+        raise SystemExit(f"unknown role {role!r}")
+    print("RESULT:" + json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

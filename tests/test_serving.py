@@ -1,0 +1,509 @@
+"""Train-to-serve delta streaming (dgc_tpu.serving, docs/SERVING.md).
+
+Unit layer: DeltaSpec meta/key pinning, flatten round-trip, the
+encode/decode/apply wire path with its error-feedback carryover, the
+exporter/replica protocol over real files (gap -> auto resync -> rebase),
+the fleet serving lane, the ``stale_replica -> resync`` control rule, and
+the regress-gate extraction of ``wire_bytes_per_update``.
+
+Drill layer: a real 1-trainer / 2-replica multiprocess drill
+(tests/serving_worker.py, file-logged subprocesses in the
+tests/test_multiprocess.py pattern) with an injected dropped delta; the
+PARENT runs the control plane — monitor.collect over the run dir,
+RuleEngine with the shipped rules, audited ``resync`` execution — and the
+drill passes only if both replicas end bitwise-identical to the trainer's
+published head after the control-driven rebase.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dgc_tpu.control import actions as ctl_actions
+from dgc_tpu.control import rules as ctl_rules
+from dgc_tpu.serving import (
+    DeltaSpec,
+    Exporter,
+    Replica,
+    protocol,
+    read_manifest,
+    read_resync_request,
+    request_resync,
+)
+from dgc_tpu.telemetry import fleet as tfleet
+from dgc_tpu.telemetry import monitor as tmonitor
+from dgc_tpu.telemetry import registry
+from dgc_tpu.telemetry import regress
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(24, 16).astype(np.float32),
+            "b": rng.randn(24).astype(np.float32),
+            "s": np.float32(0.5)}
+
+
+# --------------------------------------------------------------------- #
+# DeltaSpec: meta/key, flatten, wire path                                #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_spec_meta_round_trip_and_key_pinning():
+    spec = DeltaSpec.from_params(_params(), 0.05)
+    meta = spec.meta()
+    again = DeltaSpec.from_meta(meta)
+    assert again.key() == spec.key()
+    assert again.shapes == spec.shapes
+
+    bad = dict(meta, format="not-a-delta-stream")
+    with pytest.raises(ValueError, match="format"):
+        DeltaSpec.from_meta(bad)
+    newer = dict(meta, format_version=999)
+    with pytest.raises(ValueError, match="resync"):
+        DeltaSpec.from_meta(newer)
+    # a tampered key (ratio drift between ends) is a loud error, not a
+    # silent mis-apply
+    drift = dict(meta, ratio=0.5)
+    with pytest.raises(ValueError, match="key"):
+        DeltaSpec.from_meta(drift)
+
+
+@pytest.mark.fast
+def test_flatten_unflatten_bitwise():
+    p = _params(1)
+    spec = DeltaSpec.from_params(p, 0.05)
+    flat = spec.flatten(p)
+    assert flat.dtype == np.float32 and flat.ndim == 1
+    back = spec.unflatten(flat)
+    assert sorted(back) == sorted(p)
+    for n in p:
+        np.testing.assert_array_equal(back[n],
+                                      np.asarray(p[n], np.float32))
+    with pytest.raises(ValueError, match="shape"):
+        spec.flatten({"w": p["w"], "b": p["b"], "s": np.zeros(3)})
+
+
+@pytest.mark.fast
+def test_encode_decode_apply_deterministic():
+    p = _params(2)
+    spec = DeltaSpec.from_params(p, 0.1)
+    rng = np.random.RandomState(3)
+    delta = rng.randn(spec.layout.total).astype(np.float32) * 0.01
+    art1 = spec.encode(delta)
+    art2 = spec.encode(delta)
+    for k in ("scales", "values", "words"):
+        np.testing.assert_array_equal(art1[k], art2[k])
+    values, idx = spec.decode(art1)
+    assert values.shape == idx.shape == (spec.payload,)
+    # decoded coordinates stay inside the flat state (receiver row clamp)
+    assert int(idx.min()) >= 0 and int(idx.max()) < spec.layout.total
+    base = np.zeros(spec.layout.total, np.float32)
+    out1 = spec.apply(base, art1)
+    out2 = spec.apply(base, art1)
+    np.testing.assert_array_equal(out1, out2)
+    assert 0 < int(np.count_nonzero(out1)) <= spec.payload
+
+
+@pytest.mark.fast
+def test_error_feedback_converges_on_static_target():
+    """What top-k + int4 does not send stays in live - published and
+    rides later deltas: repeated publishes of one fixed target drive the
+    published state toward it (the serving analogue of DGC residual
+    accumulation)."""
+    p0 = _params(4)
+    spec = DeltaSpec.from_params(p0, 0.05)
+    rng = np.random.RandomState(5)
+    # perturb the PARAMS (not the flat buffer: layout padding slots are
+    # structurally unaddressable by the wire, by design)
+    pt = {n: np.asarray(v, np.float32)
+          + np.asarray(rng.randn(*np.shape(v)), np.float32) * 0.1
+          for n, v in p0.items()}
+    target = spec.flatten(pt)
+    published = spec.flatten(p0)
+    errs = []
+    for _ in range(60):
+        published = spec.apply(published, spec.encode(target - published))
+        errs.append(float(np.max(np.abs(target - published))))
+    assert errs[-1] < errs[0] * 0.05, errs[::8]
+
+
+@pytest.mark.fast
+def test_wire_accounting_and_describe():
+    p = _params(6)
+    spec = DeltaSpec.from_params(p, 0.05)
+    d = spec.describe()
+    wire = spec.wire_bytes_per_update()
+    full = spec.full_checkpoint_bytes()
+    assert d["wire_bytes_per_update"] == wire
+    assert d["full_checkpoint_bytes"] == full == 4 * spec.layout.num_params
+    # the acceptance bound the ResNet-20 bench row is gated on, scaled
+    # here to the toy model at 5% density
+    assert wire <= 0.10 * full
+    assert d["wire_frac"] == pytest.approx(wire / full, abs=1e-6)
+
+
+@pytest.mark.fast
+def test_spec_refuses_unshardable_streams():
+    with pytest.raises(ValueError, match="shard"):
+        DeltaSpec({"huge": [2 ** 16, 2 ** 15]}, 0.001)
+
+
+# --------------------------------------------------------------------- #
+# protocol: atomic files, tolerant reads                                 #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_protocol_tolerant_reads_and_resync_files(tmp_path):
+    d = str(tmp_path)
+    assert read_manifest(d) is None
+    assert protocol.load_npz(protocol.base_path(d, 1)) is None
+    # a torn manifest reads as absent, never raises
+    with open(os.path.join(d, protocol.MANIFEST), "w") as f:
+        f.write('{"base_version": 1, "latest')
+    assert read_manifest(d) is None
+
+    assert read_resync_request(d) is None
+    req = request_resync(d, "stale_replica", replicas=["r1"])
+    got = read_resync_request(d)
+    assert got["event"] == "resync_request"
+    assert got["reason"] == "stale_replica" == req["reason"]
+    assert got["replicas"] == ["r1"]
+    protocol.clear_resync_request(d)
+    assert read_resync_request(d) is None
+    protocol.clear_resync_request(d)        # idempotent
+
+
+# --------------------------------------------------------------------- #
+# exporter <-> replica over real files                                   #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_exporter_replica_parity_gap_resync(tmp_path, monkeypatch):
+    monkeypatch.delenv("DGC_SERVE_DROP", raising=False)
+    d = str(tmp_path / "serving")
+    p = _params(7)
+    exp = Exporter(d, p, ratio=0.1, max_lag=3,
+                   lineage={"epoch": 1, "step": 100})
+    man = read_manifest(d)
+    assert man["base_version"] == 1 and man["latest_seq"] == 0
+    assert man["lineage"]["epoch"] == 1
+
+    rep = Replica(d, name="r0", auto_resync=True)
+    st = rep.poll()
+    registry.validate_replica_status(st)
+    assert st["health"] == "ok" and st["staleness"] == 0
+    assert rep.digest() == exp.digests["1:0"]
+
+    # several delta ticks: bitwise parity at every head
+    rng = np.random.RandomState(8)
+    for i in range(4):
+        p = {n: np.asarray(v, np.float32)
+             + np.asarray(rng.randn(*np.shape(v)), np.float32) * 0.01
+             for n, v in p.items()}
+        rec = exp.publish(p, step=101 + i)
+        assert rec["kind"] == "delta" and not rec["dropped"]
+        st = rep.poll()
+        assert st["health"] == "ok" and st["delta_seq"] == i + 1
+        assert rep.digest() == rec["digest"]
+    assert rep.applied_deltas == 4
+
+    # inject a dropped artifact: gap -> auto resync request -> rebase
+    monkeypatch.setenv("DGC_SERVE_DROP", "5")
+    rec = exp.publish(p, step=105)
+    assert rec["dropped"]
+    monkeypatch.delenv("DGC_SERVE_DROP")
+    st = rep.poll()
+    assert st["health"] == "gap" and rep.gaps == 1
+    assert read_resync_request(d) is not None
+    rec = exp.publish(p, step=106)
+    assert rec["kind"] == "base" and rec["base_version"] == 2
+    assert rec["request"]["reason"].startswith("gap at 1:5")
+    st = rep.poll()
+    assert st["health"] == "ok"
+    assert st["base_version"] == 2 and st["delta_seq"] == 0
+    assert rep.resyncs == 1
+    assert rep.digest() == exp.digests["2:0"]
+    # served params reshape losslessly
+    assert sorted(rep.params()) == sorted(p)
+
+
+@pytest.mark.fast
+def test_replica_without_auto_resync_waits_for_control(tmp_path,
+                                                       monkeypatch):
+    d = str(tmp_path / "serving")
+    p = _params(9)
+    exp = Exporter(d, p, ratio=0.1, max_lag=2)
+    rep = Replica(d, name="r1", auto_resync=False)
+    rep.poll()
+    monkeypatch.setenv("DGC_SERVE_DROP", "1")
+    exp.publish(p)
+    monkeypatch.delenv("DGC_SERVE_DROP")
+    st = rep.poll()
+    assert st["health"] == "gap"
+    # no self-service: the request file is the control plane's to write
+    assert read_resync_request(d) is None
+
+
+# --------------------------------------------------------------------- #
+# telemetry: registry schema, fleet lane, monitor gauges                 #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_registry_serving_schema():
+    assert "resync" in registry.control_action_names()
+    assert set(registry.serving_stat_names()) >= {
+        "staleness", "base_version", "delta_seq", "gaps"}
+    # the actions table and the registry must agree (audit requirement)
+    assert set(ctl_actions.ACTIONS) <= set(registry.control_action_names())
+    assert "wire_bytes_per_update" in {
+        s.name for s in registry.RUN_METRICS}
+
+    rec = Replica("/nonexistent", name="rX").status(latest_seq=-1,
+                                                    max_lag=0)
+    registry.validate_replica_status(rec)
+    with pytest.raises(ValueError, match="replica_status"):
+        registry.validate_replica_status(dict(rec, event="nope"))
+    bad = dict(rec)
+    del bad["staleness"]
+    with pytest.raises(ValueError, match="staleness"):
+        registry.validate_replica_status(bad)
+    with pytest.raises(ValueError, match="replica"):
+        registry.validate_replica_status(dict(rec, replica=""))
+
+
+def _drill_dir(tmp_path, *, stale=False):
+    """A run dir with a live stream and two replica status files."""
+    run = tmp_path / "run"
+    d = str(run / "serving")
+    p = _params(10)
+    exp = Exporter(d, p, ratio=0.1, max_lag=2)
+    for _ in range(3):
+        exp.publish(p)
+    r0 = Replica(d, name="r0")
+    r0.poll()
+    r0.write_status(d, latest_seq=3, max_lag=2)
+    r1 = Replica(d, name="r1", auto_resync=False)
+    if stale:
+        # r1 never applied past the base: staleness 3 > max_lag 2
+        r1.poll()
+        r1.delta_seq = 0
+        r1._health = "gap"
+        r1.gaps = 1
+    else:
+        r1.poll()
+    r1.write_status(d, latest_seq=3, max_lag=2)
+    return str(run), d
+
+
+@pytest.mark.fast
+def test_fleet_serving_summary(tmp_path):
+    run, d = _drill_dir(tmp_path, stale=True)
+    assert tfleet.discover_serving(run) == d
+    s = tfleet.serving_summary(d)
+    assert s["head"]["base_version"] == 1
+    assert s["head"]["latest_seq"] == 3
+    assert s["num_replicas"] == 2
+    assert s["stale_replicas"] == ["r1"]
+    assert s["replicas"]["r0"]["health"] == "ok"
+    assert s["max_staleness"] == 3
+    # a corrupt status file is counted, not trusted
+    with open(os.path.join(d, "replica_zz.json"), "w") as f:
+        f.write("{broken")
+    s = tfleet.serving_summary(d)
+    assert s["bad_status"] == 1 and s["num_replicas"] == 2
+
+
+@pytest.mark.fast
+def test_monitor_serving_lane(tmp_path):
+    run, _ = _drill_dir(tmp_path, stale=True)
+    # serving-only run dirs are monitorable (no trainer telemetry here)
+    snap = tmonitor.collect(run)
+    assert snap["serving"]["stale_replicas"] == ["r1"]
+    om = tmonitor.render_openmetrics(snap)
+    assert "dgc_serving_latest_seq" in om
+    assert 'dgc_replica_staleness{' in om
+    assert 'replica="r0"' in om and 'replica="r1"' in om
+    assert 'dgc_replica_healthy' in om
+    status = tmonitor.render_status(snap)
+    assert "SERVING: head v1:3" in status
+    assert "STALE=[r1]" in status
+    ranked = tmonitor.rank_runs({"runs": {run: snap}})
+    assert any("stale-replicas [r1]" in n for n in ranked[0]["notes"])
+
+
+# --------------------------------------------------------------------- #
+# control plane: stale_replica -> resync                                 #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_stale_replica_rule_fires_and_resyncs(tmp_path):
+    run, d = _drill_dir(tmp_path, stale=True)
+    snap = tmonitor.collect(run)
+    ev = ctl_rules.detect_stale_replica(snap)
+    assert ev["kind"] == "stale_replica"
+    assert ev["replicas"] == ["r1"]
+    assert ev["head"] == "v1:3" and ev["max_lag"] == 2
+    assert ev["health"] == {"r1": "gap"}
+
+    eng = ctl_rules.RuleEngine()      # shipped rules, min_hits=2
+    assert eng.evaluate(run, snap, now=0.0) == []
+    fired = eng.evaluate(run, snap, now=1.0)
+    assert [(r.name, e["kind"]) for r, e in fired] == [
+        ("stale-replica-resync", "stale_replica")]
+    rule, evidence = fired[0]
+    assert rule.action == "resync" and evidence["hits"] == 2
+
+    res = ctl_actions.execute("resync", None, evidence, serving_dir=d)
+    assert res["requested"]
+    req = read_resync_request(d)
+    assert req["reason"] == "stale_replica"
+    assert req["fired_by"] == "control_plane"
+    # the audit record every firing must produce validates
+    registry.validate_control_action({
+        "event": "control_action", "run": run, "run_id": "drill",
+        "rule": rule.name, "action": rule.action, "evidence": evidence,
+        "t": time.time()})
+    # healthy fleet: no evidence, no firing
+    run2, _ = _drill_dir(tmp_path / "healthy", stale=False)
+    assert ctl_rules.detect_stale_replica(tmonitor.collect(run2)) is None
+
+
+@pytest.mark.fast
+def test_regress_gate_reads_serving_wire_bytes():
+    obj = {"serving": {"wire_bytes_per_update": 925,
+                       "full_checkpoint_bytes": 1089896}}
+    out = regress._from_bench_obj(obj)
+    assert out == {"wire_bytes_per_update": 925.0}
+    rows = regress.compare({"wire_bytes_per_update": 925.0},
+                           {"wire_bytes_per_update": 1200.0}, tol=0.10)
+    assert rows[0]["regressed"]
+    rows = regress.compare({"wire_bytes_per_update": 925.0},
+                           {"wire_bytes_per_update": 900.0}, tol=0.10)
+    assert not rows[0]["regressed"]
+
+
+# --------------------------------------------------------------------- #
+# the multiprocess drill                                                 #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_serve_drill_one_trainer_two_replicas(tmp_path):
+    """1 trainer + 2 replicas as real subprocesses; delta (1, 5) is
+    dropped on the wire; the PARENT is the control plane. Passes when:
+
+    * both replicas end bitwise-identical to the trainer's published
+      head (v2:6) — apply parity across process boundaries,
+    * while healthy, observed staleness stayed within the pinned
+      ``max_lag`` bound,
+    * the injected gap produced an AUDITED ``stale-replica-resync``
+      firing (min_hits respected) whose rebase both replicas followed.
+    """
+    worker = os.path.join(os.path.dirname(__file__), "serving_worker.py")
+    run_dir = str(tmp_path)
+    serving_dir = os.path.join(run_dir, "serving")
+    os.makedirs(serving_dir, exist_ok=True)
+    target_v, target_s = 2, 6
+
+    env = {k: v for k, v in os.environ.items() if k != "DGC_SERVE_DROP"}
+    tenv = dict(env, DGC_SERVE_DROP="1:5", JAX_PLATFORMS="cpu")
+    renv = dict(env, JAX_PLATFORMS="cpu")
+    # file logs, not pipes (tests/test_multiprocess.py pattern)
+    logs = {n: open(tmp_path / f"{n}.log", "w+")
+            for n in ("trainer", "r0", "r1")}
+    procs = {
+        "trainer": subprocess.Popen(
+            [sys.executable, worker, "trainer", serving_dir,
+             str(target_v), str(target_s)],
+            stdout=logs["trainer"], stderr=subprocess.STDOUT, text=True,
+            env=tenv),
+    }
+    for name in ("r0", "r1"):
+        procs[name] = subprocess.Popen(
+            [sys.executable, worker, "replica", serving_dir, name,
+             str(target_v), str(target_s)],
+            stdout=logs[name], stderr=subprocess.STDOUT, text=True,
+            env=renv)
+
+    # the parent IS the control plane: monitor -> rules -> audited resync
+    engine = ctl_rules.RuleEngine()
+    audit_path = os.path.join(run_dir, "control_events.jsonl")
+    actions_fired = []
+    deadline = time.monotonic() + 120.0
+    while (any(p.poll() is None for p in procs.values())
+           and time.monotonic() < deadline):
+        try:
+            snap = tmonitor.collect(run_dir)
+        except FileNotFoundError:
+            time.sleep(0.2)
+            continue
+        for rule, evidence in engine.evaluate(run_dir, snap,
+                                              now=time.time()):
+            res = ctl_actions.execute(rule.action, None, evidence,
+                                      serving_dir=serving_dir)
+            rec = {"event": "control_action", "run": run_dir,
+                   "run_id": "serve-drill", "rule": rule.name,
+                   "action": rule.action, "evidence": evidence,
+                   "result": res, "t": time.time()}
+            registry.validate_control_action(rec)
+            with open(audit_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            actions_fired.append(rec)
+        time.sleep(0.2)
+
+    outs = {}
+    for name, p in procs.items():
+        try:
+            p.wait(timeout=30)
+        finally:
+            if p.poll() is None:
+                p.kill()
+        lf = logs[name]
+        lf.seek(0)
+        outs[name] = lf.read()
+        lf.close()
+    for name, p in procs.items():
+        assert p.returncode == 0, f"{name} failed:\n{outs[name][-4000:]}"
+
+    results = {}
+    for name, out in outs.items():
+        for line in out.splitlines():
+            if line.startswith("RESULT:"):
+                results[name] = json.loads(line[len("RESULT:"):])
+    assert set(results) == {"trainer", "r0", "r1"}, outs
+
+    tr = results["trainer"]
+    assert tr["base_version"] == target_v, tr   # exactly one rebase
+    assert tr["latest_seq"] >= target_s
+    # the drill's wire-volume bound, same shape as the bench acceptance
+    assert tr["wire_bytes_per_update"] <= 0.10 * tr["full_checkpoint_bytes"]
+
+    for name in ("r0", "r1"):
+        r = results[name]
+        assert r["health"] == "ok", r
+        assert r["base_version"] == target_v
+        assert r["delta_seq"] == tr["latest_seq"]
+        # bitwise apply parity across the process boundary
+        assert r["digest"] == tr["digest"], (name, r, tr)
+        # the dropped artifact was SEEN as a gap...
+        assert r["gaps"] >= 1, r
+        # ...and the control-driven rebase was followed
+        assert r["resyncs"] >= 1, r
+        # staleness while healthy stayed within the pinned bound
+        assert r["max_ok_staleness"] <= 3, r
+        assert r["param_names"] == ["b", "s", "w"]
+
+    # the resync was control-plane-driven and audited
+    assert len(actions_fired) >= 1
+    assert all(a["rule"] == "stale-replica-resync" and
+               a["action"] == "resync" for a in actions_fired)
+    with open(audit_path) as f:
+        logged = [json.loads(l) for l in f if l.strip()]
+    assert len(logged) == len(actions_fired)
+    for rec in logged:
+        registry.validate_control_action(rec)
+        assert rec["evidence"]["hits"] >= 2   # min_hits respected
