@@ -7,11 +7,19 @@ default / ``--lint``   AST lints only (milliseconds, no jax import).
 ``--verify``           dgcver jaxpr dataflow passes (docs/ANALYSIS.md
                        §Verifier); combines with any mode. ``--fast``
                        skips its compile-needing donation pass.
-``--gate``             lints + contracts; with ``--verify`` this is the
-                       CI entry wired into scripts/t1.sh.
+``--race``             dgcrace host-concurrency lints DGC201-204
+                       (AST-only, milliseconds; docs/ANALYSIS.md
+                       §Layer 4); combines with any mode.
+``--mc``               dgcmc crash-consistency model checker over the
+                       file protocols (implies ``--race``; docs/
+                       ANALYSIS.md §Layer 4; ``DGC_MC_MUTATE`` seeds a
+                       bug that must turn it red).
+``--gate``             lints + contracts; with ``--verify --mc`` this
+                       is the CI entry wired into scripts/t1.sh.
 
 Exit codes: 0 clean, 1 violations (un-allowlisted lint findings, any
-failed contract, or any un-waived verifier finding), 2 usage/internal
+failed contract, any un-waived verifier finding, any un-allowed race
+finding, or any model-checker protocol violation), 2 usage/internal
 error.
 """
 
@@ -48,9 +56,19 @@ def main(argv=None) -> int:
     ap.add_argument("--verify", action="store_true",
                     help="dgcver jaxpr dataflow passes (collective-axis, "
                          "dtype-flow, donation-liveness, ef-conservation)")
+    ap.add_argument("--race", action="store_true",
+                    help="dgcrace host-concurrency lints DGC201-204 "
+                         "(thread-shared state, crash-handler files, "
+                         "traced-state writes, join-less spawns)")
+    ap.add_argument("--mc", action="store_true", dest="mc",
+                    help="dgcmc crash-consistency model checker over "
+                         "the coordination file protocols (implies "
+                         "--race)")
     ap.add_argument("--fast", action="store_true",
                     help="with --verify: trace-only, skip the "
-                         "compile-needing donation pass + report")
+                         "compile-needing donation pass + report; with "
+                         "--mc: skip the orbax-heavy checkpoint "
+                         "scenario")
     ap.add_argument("--allowlist", default=None, metavar="TOML",
                     help="override analysis/allowlist.toml")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -62,7 +80,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     do_contracts = args.contracts or args.gate
-    do_lint = args.lint or args.gate or not (args.contracts or args.verify)
+    do_race = args.race or args.mc
+    do_lint = args.lint or args.gate or not (
+        args.contracts or args.verify or do_race)
     rc = 0
 
     if do_lint:
@@ -99,6 +119,43 @@ def main(argv=None) -> int:
         print(f"dgclint: contracts {len(results) - len(failed)}/"
               f"{len(results)} ok")
         if failed:
+            rc = 1
+
+    if do_race:
+        from dgc_tpu.analysis.racelint import race_lint_paths
+        try:
+            allowlist = load_allowlist(args.allowlist)
+        except ValueError as e:
+            print(f"dgcrace: bad allowlist: {e}", file=sys.stderr)
+            return 2
+        rfindings = race_lint_paths(args.paths or DEFAULT_ROOTS,
+                                    allowlist=allowlist, root=args.root)
+        rbad = [f for f in rfindings if not f.allowed]
+        if args.as_json:
+            print(json.dumps([vars(f) for f in rfindings], indent=2))
+        else:
+            shown = rfindings if args.show_allowed else rbad
+            for f in shown:
+                print(f.format())
+            n_allowed = sum(f.allowed for f in rfindings)
+            print(f"dgcrace: {len(rbad)} violation(s), "
+                  f"{n_allowed} allowlisted")
+        if rbad:
+            rc = 1
+
+    if args.mc:
+        _ensure_devices()
+        from dgc_tpu.analysis.mc import run_mc_suite
+        mresults = run_mc_suite(log=lambda s: print(f"dgcmc: {s}"),
+                                fast=args.fast)
+        mfailed = [(n, v) for n, v in mresults if v]
+        for name, violations in mfailed:
+            print(f"MC FAIL {name}")
+            for v in violations:
+                print(f"  - {v}")
+        print(f"dgcmc: protocols {len(mresults) - len(mfailed)}/"
+              f"{len(mresults)} ok")
+        if mfailed:
             rc = 1
 
     if args.verify:

@@ -25,8 +25,9 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["Rule", "RULES", "VERIFY_PASSES", "RULES_BY_ID", "Finding",
-           "Allowlist", "load_allowlist", "DEFAULT_ALLOWLIST_PATH"]
+__all__ = ["Rule", "RULES", "VERIFY_PASSES", "RACE_RULES", "RULES_BY_ID",
+           "Finding", "Allowlist", "load_allowlist",
+           "DEFAULT_ALLOWLIST_PATH"]
 
 DEFAULT_ALLOWLIST_PATH = os.path.join(os.path.dirname(__file__),
                                       "allowlist.toml")
@@ -98,7 +99,34 @@ VERIFY_PASSES: Tuple[Rule, ...] = (
          "transmit-record/residual fold-back sink", True),
 )
 
-RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES + VERIFY_PASSES}
+#: dgcmc race-lint rules (docs/ANALYSIS.md §Layer 4). Like VERIFY_PASSES,
+#: kept separate from RULES — detection lives in
+#: :mod:`dgc_tpu.analysis.racelint`, with its own pos/neg fixture pairs —
+#: but registered in RULES_BY_ID so allowlist.toml entries, inline
+#: waivers and Finding.format() work identically across layers.
+RACE_RULES: Tuple[Rule, ...] = (
+    Rule("thread-shared-state", "DGC201",
+         "module/instance state written by a spawned thread and accessed "
+         "by another thread with no shared lock on every access — the "
+         "Eraser lockset condition (guard with one Lock, or hand the "
+         "value over a queue/Event)", False),
+    Rule("thread-crash-file", "DGC202",
+         "a spawned thread and a signal/atexit crash handler write the "
+         "same file — a crash mid-write interleaves the two writers on "
+         "one path (route both through one atomic publisher)", False),
+    Rule("thread-traced-state", "DGC203",
+         "a spawned thread mutates state that traced (jitted) scope "
+         "reads: the first trace bakes the value into the jaxpr cache "
+         "and the thread's updates are silently ignored (thread the "
+         "value as a step argument)", False),
+    Rule("thread-no-join", "DGC204",
+         "non-daemon Thread never joined in its module: interpreter "
+         "shutdown blocks on it forever (daemon=True, or join with a "
+         "timeout)", False),
+)
+
+RULES_BY_ID: Dict[str, Rule] = {
+    r.id: r for r in RULES + VERIFY_PASSES + RACE_RULES}
 
 #: inline waivers: ``# dgclint: ok`` / ``# dgclint: ok[id,id]`` for the
 #: AST layer, ``# dgcver: ok`` / ``# dgcver: ok[pass-id]`` for verifier

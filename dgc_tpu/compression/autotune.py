@@ -243,11 +243,10 @@ class Autotuner:
                 "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
             },
         }
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(obj, fh, indent=2)
-        os.replace(tmp, path)
+        # route through the blessed rename-atomic publisher: the old
+        # predictable-name `path + ".tmp"` stage let two refitting
+        # processes clobber each other's tmp, and skipped the fsync that
+        # keeps a published-then-crashed fabric from tearing
+        from dgc_tpu.serving import protocol as _sproto
+        _sproto.write_json_atomic(path, obj)
         return path

@@ -14,7 +14,6 @@ re-forms the cohort at W', and restores with ``--elastic`` resharding.
 """
 
 import os
-import tempfile
 from typing import Dict, Optional
 
 from dgc_tpu.control.supervisor import Supervisor, parse_env_file
@@ -27,24 +26,18 @@ __all__ = ["publish_env", "default_cohort_planner", "act_restart",
 def publish_env(path: str, updates: Dict[str, str]) -> Dict[str, str]:
     """Merge ``updates`` into the KEY=VALUE env-file at ``path`` and
     rewrite it atomically (the supervisor re-reads it before every
-    launch; it must never see a torn file). Returns the merged spec."""
+    launch; it must never see a torn file — a truncated
+    ``JAX_NUM_PROCESSES=32`` still PARSES as 3, so writer atomicity is
+    the only defense). Returns the merged spec."""
+    # lazy import: dgc_tpu.serving.__init__ pulls the exporter (and
+    # thus jax); the control package must stay importable without it
+    from dgc_tpu.serving import protocol as _sproto
     merged = parse_env_file(path)
     merged.update({k: str(v) for k, v in updates.items()})
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".cohort.", suffix=".env")
-    try:
-        with os.fdopen(fd, "w") as f:
-            f.write("# published by dgc_tpu.control\n")
-            for k in sorted(merged):
-                f.write(f"{k}={merged[k]}\n")
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    lines = ["# published by dgc_tpu.control"]
+    lines += [f"{k}={merged[k]}" for k in sorted(merged)]
+    _sproto.write_text_atomic(path, "\n".join(lines) + "\n",
+                              prefix=".cohort.", suffix=".env")
     return merged
 
 

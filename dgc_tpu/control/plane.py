@@ -33,7 +33,6 @@ each run dir and the fleet root for the monitor's COHORT line and the
 import json
 import os
 import subprocess
-import tempfile
 import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -316,6 +315,8 @@ class ControlPlane:
         """Atomic ``cohort.json`` under each run dir + the fleet root:
         the monitor's COHORT line and the ``dgc_cohort_size`` /
         ``dgc_pool_free`` gauges read these."""
+        # lazy import: serving.__init__ pulls jax via the exporter
+        from dgc_tpu.serving import protocol as _sproto
         per_run = {n: self._cohort_state(n) for n in self.specs}
         fleet = dict(self.pool.snapshot(), t=time.time(),
                      runs={n: self.pool.state.get(n) for n in self.specs})
@@ -325,12 +326,7 @@ class ControlPlane:
                  for n in self.specs]
                 + [(fleet, os.path.join(self.fleet_root, COHORT_FILE))]):
             try:
-                d = os.path.dirname(path)
-                fd, tmp = tempfile.mkstemp(dir=d, prefix=".cohort.",
-                                           suffix=".tmp")
-                with os.fdopen(fd, "w") as f:
-                    json.dump(payload, f)
-                os.replace(tmp, path)
+                _sproto.write_json_atomic(path, payload)
             except OSError:
                 pass    # a full disk must not stop the control loop
 

@@ -162,15 +162,21 @@ class Supervisor:
                        if os.environ.get(k) is not None}
         self._events = JsonlAppender(events) if events else None
         self._wake = threading.Event()
+        # guards child/quarantined/shutting_down/launches/cohort — shared
+        # between run(), the hang-watch thread, and cross-thread
+        # request_*() callers. Never held across Popen/wait/event I/O.
+        self._lock = threading.Lock()
 
     # ------------------------------------------------------------------ #
     # events                                                             #
     # ------------------------------------------------------------------ #
 
     def event(self, kind, **fields):
+        with self._lock:
+            launches, cohort = self.launches, dict(self.cohort)
         rec = dict(fields, event=kind, t=time.time(),
-                   launches=self.launches, run_id=self.run_id,
-                   cohort=self.cohort)
+                   launches=launches, run_id=self.run_id,
+                   cohort=cohort)
         tag = f"[supervise:{self.name}]" if self.name else "[supervise]"
         line = json.dumps(rec)
         print(f"{tag} {line}", flush=True)
@@ -190,7 +196,8 @@ class Supervisor:
     # ------------------------------------------------------------------ #
 
     def _signal_child(self, signum=signal.SIGTERM):
-        child = self.child
+        with self._lock:
+            child = self.child
         if child is not None and child.poll() is None:
             try:
                 child.send_signal(signum)
@@ -212,8 +219,9 @@ class Supervisor:
         process (SIGTERM would route to a signal handler the process may
         never service again). Quarantines the run first so the loop
         holds the corpse for post-mortem instead of relaunching it."""
-        if self.quarantined is None:
-            self.quarantined = f"hang:{reason}"
+        with self._lock:
+            if self.quarantined is None:
+                self.quarantined = f"hang:{reason}"
         delivered = self._signal_child(signal.SIGKILL)
         self.event("hang_kill", reason=reason, delivered=delivered)
         return delivered
@@ -221,7 +229,8 @@ class Supervisor:
     def request_stop(self, reason="signal"):
         """Stop relaunching and pass SIGTERM through so the child takes
         its emergency-save path (the CLI signal handler routes here)."""
-        self.shutting_down = True
+        with self._lock:
+            self.shutting_down = True
         self._signal_child(signal.SIGTERM)
         self._wake.set()
 
@@ -229,14 +238,16 @@ class Supervisor:
         """Stop relaunching but keep every artifact (telemetry, flight
         dump, checkpoints) for post-mortem. Does NOT kill a live child —
         a run is quarantined for what it did, not executed for it."""
-        if self.quarantined is None:
-            self.quarantined = str(reason)
+        with self._lock:
+            if self.quarantined is None:
+                self.quarantined = str(reason)
         self._wake.set()
 
     def _forward(self, signum, frame):
         # the scheduler is tearing US down: stop relaunching, pass the
         # signal through so the child takes its emergency-save path
-        self.shutting_down = True
+        with self._lock:
+            self.shutting_down = True
         self._signal_child(signum)
         self._wake.set()
 
@@ -252,7 +263,9 @@ class Supervisor:
         poll = max(0.05, min(1.0, self.hang_timeout / 4.0))
         while child.poll() is None:
             time.sleep(poll)
-            if child.poll() is not None or self.child is not child:
+            with self._lock:
+                current = self.child
+            if child.poll() is not None or current is not child:
                 return
             try:
                 last = os.path.getmtime(self.heartbeat)
@@ -333,25 +346,31 @@ class Supervisor:
             env["DGC_RUN_ID"] = self.run_id
             # latest cohort spec (the env-file may have re-shaped the
             # world since the last launch) rides every event from here on
-            self.cohort = {k: env.get(k) for k in COHORT_KEYS
-                           if env.get(k) is not None}
+            cohort = {k: env.get(k) for k in COHORT_KEYS
+                      if env.get(k) is not None}
+            with self._lock:
+                self.cohort = cohort
             if self.heartbeat:
                 # the child's Watchdog refreshes this file's mtime; the
                 # hang monitor below is its supervisor-side consumer
                 env["DGC_HEARTBEAT"] = self.heartbeat
             before = checkpoint_progress(self.watch)
-            self.launches += 1
+            with self._lock:
+                self.launches += 1
             self.event("launch", cmd=self.cmd,
                        world=env.get("JAX_NUM_PROCESSES"),
                        env_overrides=sorted(overrides))
             t0 = time.time()
-            self.child = subprocess.Popen(self.cmd, env=env)
+            child = subprocess.Popen(self.cmd, env=env)
+            with self._lock:
+                self.child = child
             if self.hang_timeout and self.heartbeat:
                 threading.Thread(target=self._watch_hang,
-                                 args=(self.child, t0),
+                                 args=(child, t0),
                                  name="dgc-hang-watch", daemon=True).start()
-            rc = self.child.wait()
-            self.child = None
+            rc = child.wait()
+            with self._lock:
+                self.child = None
             self.last_rc = rc
             elapsed = time.time() - t0
             if rc in self.success_codes:
@@ -367,26 +386,34 @@ class Supervisor:
                 failures = 0
             else:
                 failures += 1
-            if (rc in self.surgery_codes and self.quarantined is None
-                    and not self.shutting_down):
+            with self._lock:
+                surgery_due = (rc in self.surgery_codes
+                               and self.quarantined is None
+                               and not self.shutting_down)
+            if surgery_due:
                 info = self._apply_surgery(rc)
                 if info.pop("excised", False):
                     # the shrunk spec has no seat for this worker: it is
                     # the one being cut out — hold it for the readmit
                     # probe instead of relaunching into a dead slot
-                    self.quarantined = \
-                        f"excised:{info.get('verdict') or rc}"
+                    with self._lock:
+                        self.quarantined = \
+                            f"excised:{info.get('verdict') or rc}"
                 else:
                     failures = 0    # a deliberate transition, not a crash
                     self.event("surgery", rc=rc, elapsed=elapsed, **info)
                     continue
-            if rc in self.quarantine_codes and self.quarantined is None:
-                self.quarantined = f"exit:{rc}"
-            if self.quarantined is not None:
+            with self._lock:
+                if (rc in self.quarantine_codes
+                        and self.quarantined is None):
+                    self.quarantined = f"exit:{rc}"
+                quarantined = self.quarantined
+                stopping = self.shutting_down
+            if quarantined is not None:
                 self.state = "quarantined"
-                self.event("quarantined", rc=rc, reason=self.quarantined)
+                self.event("quarantined", rc=rc, reason=quarantined)
                 return rc
-            if self.shutting_down:
+            if stopping:
                 self.state = "stopped"
                 self.event("stopped", rc=rc, reason="signal")
                 return rc
@@ -404,11 +431,14 @@ class Supervisor:
             # instead of after the full delay
             self._wake.wait(delay)
             self._wake.clear()
-            if self.quarantined is not None:
+            with self._lock:
+                quarantined = self.quarantined
+                stopping = self.shutting_down
+            if quarantined is not None:
                 self.state = "quarantined"
-                self.event("quarantined", rc=rc, reason=self.quarantined)
+                self.event("quarantined", rc=rc, reason=quarantined)
                 return rc
-            if self.shutting_down:
+            if stopping:
                 self.state = "stopped"
                 self.event("stopped", rc=rc, reason="signal")
                 return rc

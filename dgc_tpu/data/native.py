@@ -104,7 +104,10 @@ def _build() -> Optional[ctypes.CDLL]:
                 ["gcc", "-O3", "-fopenmp", "-shared", "-fPIC",
                  c_path, "-o", tmp_so],
                 check=True, capture_output=True, timeout=60)
-            os.rename(tmp_so, so_path)
+            # replace, not rename: a racing builder (two loaders on one
+            # host) or a crashed-then-retried build must not wedge on an
+            # existing target
+            os.replace(tmp_so, so_path)
         lib = ctypes.CDLL(so_path)
     except (OSError, subprocess.SubprocessError):
         return None

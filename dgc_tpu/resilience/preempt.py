@@ -104,6 +104,9 @@ class Watchdog:
             0.1, timeout / 4.0)
         self._heartbeat_path = heartbeat_path
         self._hb_last = 0.0
+        # _last/stalls are shared between beat() (train loop) and the
+        # watchdog thread; a torn check-then-rearm misattributes a stall
+        self._lock = threading.Lock()
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run,
@@ -113,9 +116,10 @@ class Watchdog:
             self._write_heartbeat()     # supervisor sees life before step 1
 
     def beat(self):
-        self._last = time.monotonic()
-        if self._heartbeat_path and (time.monotonic() - self._hb_last
-                                     >= 1.0):
+        now = time.monotonic()
+        with self._lock:
+            self._last = now
+        if self._heartbeat_path and now - self._hb_last >= 1.0:
             self._write_heartbeat()
 
     def _write_heartbeat(self):
@@ -128,9 +132,12 @@ class Watchdog:
 
     def _run(self):
         while not self._stop.wait(self._interval):
-            if time.monotonic() - self._last <= self.timeout:
+            with self._lock:
+                idle = time.monotonic() - self._last
+            if idle <= self.timeout:
                 continue
-            self.stalls += 1
+            with self._lock:
+                self.stalls += 1
             stream = self._stream or sys.stderr
             try:
                 print(f"[watchdog] no step progress for >{self.timeout}s "
@@ -160,7 +167,8 @@ class Watchdog:
                     self._on_stall()
                 except Exception:
                     pass
-            self._last = time.monotonic()   # rearm
+            with self._lock:
+                self._last = time.monotonic()   # rearm
 
     def stop(self):
         self._stop.set()

@@ -40,7 +40,6 @@ contracts in ``analysis/suite.py`` pin that.
 
 import json
 import os
-import tempfile
 import threading
 import time
 from typing import NamedTuple, Optional
@@ -91,17 +90,13 @@ class Agreement(NamedTuple):
 # ------------------------------------------------------------------ #
 
 def _atomic_write_json(path, payload):
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".surgery.", suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(payload, f, indent=2, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)   # atomic on POSIX: readers never see a torn file
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    # one blessed publish idiom tree-wide (mkstemp+fsync+replace): the
+    # model checker verifies serving.protocol.write_json_atomic and every
+    # protocol that routes through it inherits the proof. Lazy import —
+    # serving.__init__ pulls jax via the exporter and the supervisor
+    # process must not pay (or require) that.
+    from dgc_tpu.serving import protocol as _sproto
+    _sproto.write_json_atomic(path, payload)
     return path
 
 

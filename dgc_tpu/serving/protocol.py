@@ -20,15 +20,16 @@ observes a torn file and a crashed writer leaves only ``*.tmp`` litter.
 import json
 import os
 import tempfile
+import zipfile
 from typing import Dict, Optional
 
 import numpy as np
 
 __all__ = [
     "MANIFEST", "RESYNC_REQUEST", "base_path", "delta_path",
-    "write_json_atomic", "read_json", "read_manifest", "save_npz_atomic",
-    "load_npz", "request_resync", "read_resync_request",
-    "clear_resync_request",
+    "write_json_atomic", "write_text_atomic", "read_json", "read_manifest",
+    "save_npz_atomic", "load_npz", "request_resync",
+    "read_resync_request", "clear_resync_request",
 ]
 
 MANIFEST = "manifest.json"
@@ -52,6 +53,29 @@ def write_json_atomic(path: str, obj: Dict) -> None:
     try:
         with os.fdopen(fd, "w") as f:
             json.dump(obj, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_text_atomic(path: str, text: str, prefix: str = ".atomic.",
+                      suffix: str = ".tmp") -> None:
+    """Publish a text file with the same mkstemp+fsync+replace discipline
+    as :func:`write_json_atomic` — the one choke point for every
+    non-JSON publish (the supervisor env-file) so the model checker
+    verifies a single idiom."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix, suffix=suffix)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -104,11 +128,15 @@ def save_npz_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
 
 
 def load_npz(path: str) -> Optional[Dict[str, np.ndarray]]:
-    """Load an artifact; None when absent (a gap) or unreadable."""
+    """Load an artifact; None when absent (a gap) or unreadable. The
+    catch set covers every shape a truncated zip container takes:
+    np.load raises BadZipFile/EOFError/KeyError (not just OSError/
+    ValueError) depending on WHERE the byte boundary falls."""
     try:
         with np.load(path) as z:
             return {k: np.asarray(z[k]) for k in z.files}
-    except (OSError, ValueError):
+    except (OSError, ValueError, KeyError, EOFError,
+            zipfile.BadZipFile):
         return None
 
 

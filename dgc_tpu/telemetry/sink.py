@@ -114,6 +114,10 @@ class TelemetrySink:
         self._fleet = bool(fleet)
         self._rotate_bytes = int(rotate_bytes)
         self._rotations = 0
+        # dropped-record counter is bumped from both the caller thread
+        # (_put on queue-full) and the drain thread (bad record) — a
+        # bare += loses updates between them
+        self._drop_lock = threading.Lock()
         self._dropped = 0
         self._fh = None
         if not self.enabled:
@@ -163,9 +167,11 @@ class TelemetrySink:
             return
         self._q.put(_CLOSE)
         self._thread.join(timeout=60)
-        if self._dropped:
+        with self._drop_lock:
+            dropped = self._dropped
+        if dropped:
             self._fh.write(json.dumps(
-                {"event": "sink_dropped", "count": self._dropped}) + "\n")
+                {"event": "sink_dropped", "count": dropped}) + "\n")
         self._fh.close()
         self._fh = None
 
@@ -181,7 +187,8 @@ class TelemetrySink:
         try:
             self._q.put_nowait(item)
         except queue.Full:
-            self._dropped += 1
+            with self._drop_lock:
+                self._dropped += 1
 
     def _open_file(self, path: str) -> None:
         self._fh = open(path, "w")
@@ -211,7 +218,8 @@ class TelemetrySink:
                 self._maybe_rotate()
                 self._fh.write(json.dumps(item) + "\n")
             except Exception:
-                self._dropped += 1
+                with self._drop_lock:
+                    self._dropped += 1
             finally:
                 self._q.task_done()
 

@@ -25,6 +25,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from dgc_tpu.serving import protocol as serving_protocol
+
 __all__ = ["CheckpointManager"]
 
 
@@ -49,7 +51,7 @@ class CheckpointManager:
         """Save epoch checkpoint, update latest pointer, rotate, track best.
 
         **Atomic**: the state AND its meters.json are written to
-        ``e<N>.tmp`` and published with one ``os.rename`` — a crash or
+        ``e<N>.tmp`` and published with one ``os.replace`` — a crash or
         preemption mid-write leaves only a ``.tmp`` directory that the
         next run ignores (and ``restore`` falls back to the previous kept
         epoch), never a half-written ``e<N>`` that latest.json points at.
@@ -102,9 +104,13 @@ class CheckpointManager:
                 json.dump(payload, f)
             if os.path.exists(path):           # same-epoch overwrite
                 shutil.rmtree(path)
-            os.rename(tmp, path)
-            with open(self._meta_path(), "w") as f:
-                json.dump({"epoch": epoch}, f)
+            os.replace(tmp, path)
+            # the blessed rename-atomic idiom (and the model checker's
+            # choke point): a crash between the epoch publish and this
+            # pointer update leaves the OLD complete latest.json, and
+            # restore's kept-epoch scan still finds the new epoch
+            serving_protocol.write_json_atomic(self._meta_path(),
+                                               {"epoch": epoch})
             if best:
                 best_path = os.path.join(self.directory, "best")
                 if os.path.exists(best_path):

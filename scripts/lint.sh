@@ -4,14 +4,15 @@
 # --verify` wired into scripts/t1.sh. Extra args pass through, e.g.:
 #   scripts/lint.sh --show-allowed
 #   scripts/lint.sh bench.py scripts   # lint beyond the default roots
-#   scripts/lint.sh --fast             # lint + trace-only dgcver passes
-#                                      # (skips the compile-needing
-#                                      # donation pass; a few seconds)
+#   scripts/lint.sh --fast             # lint + race lint + trace-only
+#                                      # dgcver passes (skips the
+#                                      # compile-needing donation pass;
+#                                      # a few seconds)
 set -e
 cd "$(dirname "$0")/.."
 if [[ "$1" == "--fast" ]]; then
     shift
     exec env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis \
-        --lint --verify --fast "$@"
+        --lint --race --verify --fast "$@"
 fi
-exec python -m dgc_tpu.analysis --lint "$@"
+exec python -m dgc_tpu.analysis --lint --race "$@"

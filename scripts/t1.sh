@@ -118,12 +118,20 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
 # verifier can only ever make the tier-1 gate marginally slower
 timeout -k 10 60 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --verify \
   && echo "VERIFY_BUDGET=ok" || { echo "VERIFY_BUDGET=FAIL"; rc=1; }
+# dgcmc wall-clock budget (docs/ANALYSIS.md §Layer 4): the crash-
+# consistency model checker — every coordination protocol explored at
+# every crash point plus the host race lint — must finish inside 60 s,
+# so layer 4 can only ever make the tier-1 gate marginally slower
+timeout -k 10 60 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --mc \
+  && echo "MC_BUDGET=ok" || { echo "MC_BUDGET=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite + the dgcver jaxpr dataflow verifier
 # (collective-axis/dtype-flow/donation/ef-conservation over every pinned
-# engine config) — nonzero on any un-allowlisted finding or broken step
-# invariant (one sparse exchange, telemetry compiles away, donation
-# aliases, barrier-free fused epilogue, error feedback conserves)
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --gate --verify \
+# engine config) + the layer-4 crash-consistency checker and race lint —
+# nonzero on any un-allowlisted finding, broken step invariant (one
+# sparse exchange, telemetry compiles away, donation aliases,
+# barrier-free fused epilogue, error feedback conserves), or protocol
+# crash-safety violation
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --gate --verify --mc \
   && echo "ANALYSIS_GATE=ok" || { echo "ANALYSIS_GATE=FAIL"; rc=1; }
 exit $rc

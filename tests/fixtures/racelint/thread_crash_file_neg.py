@@ -1,0 +1,21 @@
+"""Clean twin: the handler publishes to its own path, so the crash
+path never interleaves with the worker's stream."""
+import signal
+import threading
+
+
+class Dumper:
+    def __init__(self, path):
+        self.path = path
+        signal.signal(signal.SIGTERM, self._on_term)
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            with open(self.path, "w") as f:
+                f.write("tick")
+
+    def _on_term(self, signum, frame):
+        with open(self.path + ".final", "w") as f:
+            f.write("final")

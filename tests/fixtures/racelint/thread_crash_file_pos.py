@@ -1,0 +1,22 @@
+"""Seeded violation: a worker thread and a signal handler write the
+same file — a crash mid-write interleaves the two writers."""
+import signal
+import threading
+
+
+class Dumper:
+    def __init__(self, path):
+        self.path = path
+        signal.signal(signal.SIGTERM, self._on_term)
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            with open(self.path, "w") as f:  # LINT: thread-crash-file
+                f.write("tick")
+
+    def _on_term(self, signum, frame):
+        # fires at ANY point of _run's write, including mid-line
+        with open(self.path, "w") as f:
+            f.write("final")

@@ -1,0 +1,18 @@
+"""Clean twin: the module joins the worker (bounded), so shutdown has
+an exit path."""
+import threading
+
+
+def _worker(q):
+    while True:
+        q.get()
+
+
+def start_worker(q):
+    t = threading.Thread(target=_worker, args=(q,))
+    t.start()
+    return t
+
+
+def stop_worker(t):
+    t.join(timeout=5)

@@ -1,0 +1,14 @@
+"""Seeded violation: a non-daemon thread nothing ever joins —
+interpreter shutdown blocks on it forever."""
+import threading
+
+
+def _worker(q):
+    while True:
+        q.get()
+
+
+def start_worker(q):
+    t = threading.Thread(target=_worker, args=(q,))  # LINT: thread-no-join
+    t.start()
+    return t

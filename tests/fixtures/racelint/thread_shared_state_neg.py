@@ -1,0 +1,19 @@
+"""Clean twin: every access to the shared counter holds one lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._n = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        for _ in range(100):
+            with self._lock:
+                self._n = self._n + 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._n

@@ -1,0 +1,21 @@
+"""Seeded violation: mutable state shared across threads, no lock.
+
+`# LINT: <rule-id>` marks the lines tests expect the race linter to
+flag (the emit site is the first unlocked write)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._n = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        for _ in range(100):
+            self._n = self._n + 1  # LINT: thread-shared-state
+
+    def snapshot(self):
+        # main-thread read races the worker's increment: += is
+        # read-modify-write, so updates are lost and reads tear
+        return self._n

@@ -1,0 +1,26 @@
+"""Clean twin: the mutable value rides the step as an ARGUMENT, so
+every trace sees the current value instead of the baked-in first one."""
+import threading
+
+import jax
+
+
+class Stepper:
+    def __init__(self):
+        self.scale = 1.0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    @jax.jit
+    def step(self, x, scale):
+        return x * scale
+
+    def snapshot(self):
+        with self._lock:
+            return self.scale
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.scale = self.scale * 0.5

@@ -1,0 +1,26 @@
+"""Seeded violation: a thread mutates state a traced function reads.
+
+The lock makes every access consistent — and still loses: the first
+trace bakes ``self.scale`` into the compiled step, so the thread's
+updates are silently ignored (cf. dgclint DGC108)."""
+import threading
+
+import jax
+
+
+class Stepper:
+    def __init__(self):
+        self.scale = 1.0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    @jax.jit
+    def step(self, x):
+        with self._lock:
+            return x * self.scale
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.scale = self.scale * 0.5  # LINT: thread-traced-state

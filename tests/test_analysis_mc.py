@@ -1,0 +1,192 @@
+"""dgcmc (layer 4, dynamic half): sandbox crash semantics, the
+protocol suite green at HEAD, and the seeded-mutation red tests.
+
+The sandbox is the checker's filesystem model: these tests pin its
+semantics (crash-before-op vs mid-write tears, fsync durability,
+rename-atomicity, the write-once ledger, thread/root confinement)
+independently of any scenario, then run the real suite both ways."""
+
+import builtins
+import json
+import os
+import tempfile
+
+import pytest
+
+from dgc_tpu.analysis.mc import (MUTATIONS, Crash, Sandbox, explore,
+                                 run_mc_suite, scenarios)
+from dgc_tpu.analysis.protospec import (APPEND_TAIL_TORN, PROTOCOLS,
+                                        PROTOCOLS_BY_NAME, RENAME_ATOMIC,
+                                        WRITE_ONCE)
+
+_SILENT = lambda s: None  # noqa: E731
+
+
+# --------------------------------------------------------------------- #
+# protospec sanity                                                       #
+# --------------------------------------------------------------------- #
+
+def test_protocol_specs_well_formed():
+    classes = {RENAME_ATOMIC, WRITE_ONCE, APPEND_TAIL_TORN}
+    assert len(PROTOCOLS) == 7
+    for spec in PROTOCOLS:
+        assert spec.files, spec.name
+        assert spec.invariants, spec.name
+        for fs in spec.files:
+            assert fs.atomicity in classes, (spec.name, fs.pattern)
+            assert fs.writer and fs.readers, (spec.name, fs.pattern)
+        assert PROTOCOLS_BY_NAME[spec.name] is spec
+
+
+def test_every_protocol_has_a_scenario():
+    assert ({s.name for s in scenarios()}
+            == {p.name for p in PROTOCOLS})
+    # fast mode drops exactly the orbax-heavy checkpoint scenario
+    assert ({s.name for s in scenarios(fast=True)}
+            == {p.name for p in PROTOCOLS} - {"checkpoint-epoch"})
+
+
+# --------------------------------------------------------------------- #
+# sandbox semantics                                                      #
+# --------------------------------------------------------------------- #
+
+def test_crash_is_not_an_exception():
+    # `except Exception` recovery in code under test must NOT swallow a
+    # simulated kill
+    assert issubclass(Crash, BaseException)
+    assert not issubclass(Crash, Exception)
+
+
+def test_crash_fires_before_nonwrite_op(tmp_path):
+    sb = Sandbox(str(tmp_path), crash_at=0)
+    with sb:
+        with pytest.raises(Crash):
+            open(tmp_path / "a.txt", "w")
+    assert not (tmp_path / "a.txt").exists()
+    assert sb.ops == [("create", "a.txt")]
+
+
+def test_mid_write_tear_leaves_half(tmp_path):
+    sb = Sandbox(str(tmp_path), crash_at=1)   # op 0 = create, op 1 = write
+    with sb:
+        with pytest.raises(Crash):
+            with open(tmp_path / "a.txt", "w") as f:
+                f.write("0123456789")
+    data = (tmp_path / "a.txt").read_bytes()
+    assert data == b"01234"                   # half of the torn write
+    torn = sb.apply_crash_effects()
+    # nothing was fsynced: half of the surviving suffix tears away too
+    assert (tmp_path / "a.txt").read_bytes() == b"012"
+    assert torn and "a.txt" in torn[0]
+
+
+def test_fsynced_bytes_survive_crash_effects(tmp_path):
+    from dgc_tpu.serving.protocol import write_json_atomic
+    sb = Sandbox(str(tmp_path))
+    with sb:
+        write_json_atomic(str(tmp_path / "x.json"), {"v": 1})
+    assert sb.apply_crash_effects() == []     # mkstemp+fsync+replace
+    with open(tmp_path / "x.json") as f:
+        assert json.load(f) == {"v": 1}
+    kinds = [k for k, _ in sb.ops]
+    assert "fsync" in kinds and "replace" in kinds
+
+
+def test_unsynced_replace_carries_risk(tmp_path):
+    # publish WITHOUT fsync: the rename lands, but the bytes are not
+    # durable — crash effects must tear the published file
+    sb = Sandbox(str(tmp_path))
+    with sb:
+        with open(tmp_path / "t.tmp", "w") as f:
+            f.write("0123456789abcdef")
+        os.replace(tmp_path / "t.tmp", tmp_path / "final.txt")
+    torn = sb.apply_crash_effects()
+    assert torn and "final.txt" in torn[0]
+    assert len((tmp_path / "final.txt").read_bytes()) < 16
+
+
+def test_write_once_ledger_flags_republish(tmp_path):
+    sb = Sandbox(str(tmp_path), write_once=("delta_*.npz",))
+    with sb:
+        for content in ("AAAA", "BBBB"):
+            with open(tmp_path / "d.tmp", "w") as f:
+                f.write(content)
+            os.replace(tmp_path / "d.tmp", tmp_path / "delta_1.npz")
+    assert len(sb.violations) == 1
+    assert "delta_1.npz" in sb.violations[0]
+    assert "step" in sb.violations[0]
+
+
+def test_write_once_identical_republish_is_legal(tmp_path):
+    sb = Sandbox(str(tmp_path), write_once=("delta_*.npz",))
+    with sb:
+        for _ in range(2):
+            with open(tmp_path / "d.tmp", "w") as f:
+                f.write("AAAA")
+            os.replace(tmp_path / "d.tmp", tmp_path / "delta_1.npz")
+    assert sb.violations == []
+
+
+def test_sandbox_confined_to_root(tmp_path):
+    inside = tmp_path / "in"
+    inside.mkdir()
+    sb = Sandbox(str(inside))
+    with sb:
+        with open(tmp_path / "outside.txt", "w") as f:
+            f.write("x")
+        with open(inside / "inside.txt", "w") as f:
+            f.write("y")
+    assert [rel for _, rel in sb.ops] == ["inside.txt", "inside.txt"]
+    assert (tmp_path / "outside.txt").read_text() == "x"
+
+
+def test_sandbox_restores_syscalls(tmp_path):
+    before = (builtins.open, os.replace, os.fsync, tempfile.mkstemp)
+    with Sandbox(str(tmp_path)):
+        assert builtins.open is not before[0]
+    assert (builtins.open, os.replace, os.fsync,
+            tempfile.mkstemp) == before
+
+
+# --------------------------------------------------------------------- #
+# the suite: green at HEAD, red under every seeded mutation              #
+# --------------------------------------------------------------------- #
+
+def test_suite_green_at_head():
+    results = run_mc_suite(log=_SILENT)
+    assert {n for n, _ in results} == {p.name for p in PROTOCOLS}
+    for name, violations in results:
+        assert violations == [], (name, violations[:3])
+
+
+@pytest.mark.parametrize("mutation", MUTATIONS)
+def test_mutation_turns_suite_red_naming_protocol_and_step(mutation):
+    results = run_mc_suite(log=_SILENT, mutate=mutation, fast=True)
+    red = [(n, v) for n, v in results if v]
+    assert red, f"mutation {mutation} left the suite green"
+    for name, violations in red:
+        assert name in PROTOCOLS_BY_NAME
+        # every violation names its protocol and a concrete step
+        assert all(v.startswith(f"{name} @ ") for v in violations)
+        assert any("step" in v for v in violations), violations[:3]
+
+
+def test_unknown_mutation_rejected():
+    with pytest.raises(ValueError, match="unknown mc mutation"):
+        run_mc_suite(log=_SILENT, mutate="no_such_bug")
+
+
+def test_env_var_seeds_mutation(monkeypatch):
+    monkeypatch.setenv("DGC_MC_MUTATE", "torn_tail")
+    results = run_mc_suite(log=_SILENT, fast=True)
+    assert any(v for n, v in results if n == "telemetry-stream")
+
+
+def test_explore_reports_crash_context():
+    # a scenario red under mutation carries "crash at step K (kind path)"
+    # context strings — the step-naming contract of the checker
+    scn = [s for s in scenarios(mutate="torn_tail", fast=True)
+           if s.name == "telemetry-stream"][0]
+    viols = explore(scn, log=_SILENT, mutate="torn_tail")
+    assert viols
+    assert any("crash at step" in v for v in viols)

@@ -1,0 +1,199 @@
+"""dgcrace (layer 4, static half): DGC201-204 fixture coverage, the
+audited-allowlist tree gate, and the red-to-green demo on the real
+concurrency fixes this layer motivated.
+
+Every race rule has a ``<rule>_pos.py`` / ``<rule>_neg.py`` pair under
+tests/fixtures/racelint/, same convention as the dgclint layer:
+positive fixtures mark each expected violation line with
+``# LINT: <rule-id>`` and the test asserts marker-exact agreement."""
+
+import re
+from pathlib import Path
+
+import pytest
+
+from dgc_tpu.analysis.racelint import race_lint_paths, race_lint_source
+from dgc_tpu.analysis.rules import (RACE_RULES, RULES_BY_ID, Allowlist,
+                                    load_allowlist)
+
+FIXDIR = Path(__file__).parent / "fixtures" / "racelint"
+REPO_ROOT = Path(__file__).parents[1]
+_MARK = re.compile(r"#\s*LINT:\s*([a-z0-9\-]+)")
+
+POS = sorted(FIXDIR.glob("*_pos.py"))
+NEG = sorted(FIXDIR.glob("*_neg.py"))
+
+
+def _expected(src: str):
+    return {(m.group(1), i + 1)
+            for i, line in enumerate(src.splitlines())
+            for m in [_MARK.search(line)] if m}
+
+
+@pytest.mark.parametrize("path", POS, ids=lambda p: p.stem)
+def test_positive_fixture_flags_marked_lines(path):
+    src = path.read_text()
+    want = _expected(src)
+    assert want, f"{path.name} has no LINT markers"
+    got = {(f.rule, f.line) for f in race_lint_source(src, str(path))}
+    assert got == want
+
+
+@pytest.mark.parametrize("path", NEG, ids=lambda p: p.stem)
+def test_negative_fixture_is_clean(path):
+    findings = race_lint_source(path.read_text(), str(path))
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_every_race_rule_has_fixture_pair():
+    stems = {p.stem for p in POS} | {p.stem for p in NEG}
+    for rule in RACE_RULES:
+        base = rule.id.replace("-", "_")
+        assert f"{base}_pos" in stems, f"no positive fixture for {rule.id}"
+        assert f"{base}_neg" in stems, f"no negative fixture for {rule.id}"
+
+
+def test_race_rules_registered_with_codes():
+    for rule in RACE_RULES:
+        assert RULES_BY_ID[rule.id] is rule
+        assert rule.code.startswith("DGC2")
+
+
+# --------------------------------------------------------------------- #
+# the tree gate: HEAD is clean modulo the audited allowlist              #
+# --------------------------------------------------------------------- #
+
+def test_repo_tree_has_no_unallowed_race_findings():
+    findings = race_lint_paths(root=str(REPO_ROOT))
+    bad = [f.format() for f in findings if not f.allowed]
+    assert bad == []
+    # the audited exceptions are real: the allowlist is exercised
+    assert any(f.allowed for f in findings)
+
+
+def test_race_allowlist_entries_name_race_rules():
+    allow = load_allowlist()
+    race_ids = {r.id for r in RACE_RULES}
+    audited = [e for e in allow.entries if e["rule"] in race_ids]
+    assert audited, "expected audited DGC2xx allowlist entries"
+    for e in audited:
+        assert e["reason"].strip()
+
+
+# --------------------------------------------------------------------- #
+# red -> green: the pre-fix Supervisor shape vs HEAD                     #
+# --------------------------------------------------------------------- #
+
+# Distilled from dgc_tpu/control/supervisor.py BEFORE this layer's fix:
+# the run loop (main thread) and the hang watchdog + control-plane
+# callers (other threads) touched child/quarantined/launches with no
+# lock. The linter finds every one of them.
+_PRE_FIX_SUPERVISOR = '''
+import subprocess
+import threading
+
+
+class Supervisor:
+    def __init__(self, cmd):
+        self.cmd = cmd
+        self.child = None
+        self.quarantined = None
+        self.launches = 0
+
+    def quarantine(self, reason):
+        if self.quarantined is None:      # check-then-set, no lock
+            self.quarantined = reason
+
+    def _watch_hang(self, child):
+        current = self.child              # torn read vs run()'s store
+        if current is child and self.launches > 3:
+            child.kill()
+            if self.quarantined is None:  # check-then-set across threads
+                self.quarantined = "hang"
+
+    def run(self):
+        while self.quarantined is None:
+            self.launches += 1
+            self.child = subprocess.Popen(self.cmd)
+            child = self.child
+            t = threading.Thread(target=self._watch_hang, args=(child,),
+                                 daemon=True)
+            t.start()
+            child.wait()
+            self.child = None
+'''
+
+
+def test_pre_fix_supervisor_shape_is_red():
+    findings = race_lint_source(_PRE_FIX_SUPERVISOR, "pre_fix.py")
+    rules = {f.rule for f in findings}
+    assert "thread-shared-state" in rules
+    shared = {
+        f.message.split(" is shared")[0] for f in findings
+        if f.rule == "thread-shared-state"}
+    # every unlocked cross-thread field is caught
+    assert {"Supervisor.child", "Supervisor.quarantined",
+            "Supervisor.launches"} <= shared
+
+
+@pytest.mark.parametrize("rel", [
+    "dgc_tpu/control/supervisor.py",
+    "dgc_tpu/resilience/preempt.py",
+    "dgc_tpu/telemetry/sink.py",
+])
+def test_fixed_modules_are_green_at_head(rel):
+    findings = race_lint_paths([rel], root=str(REPO_ROOT))
+    bad = [f.format() for f in findings if not f.allowed]
+    assert bad == [], bad
+
+
+# --------------------------------------------------------------------- #
+# CLI gate exit codes                                                    #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("path", POS, ids=lambda p: p.stem)
+def test_cli_race_exits_nonzero_on_seeded_violation(path, capsys):
+    from dgc_tpu.analysis.__main__ import main
+    rc = main(["--race", str(path), "--root", str(REPO_ROOT)])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_race_exits_zero_on_clean_fixtures(capsys):
+    from dgc_tpu.analysis.__main__ import main
+    rc = main(["--race"] + [str(p) for p in NEG]
+              + ["--root", str(REPO_ROOT)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_race_clean_on_repo_tree(capsys):
+    from dgc_tpu.analysis.__main__ import main
+    rc = main(["--race", "--root", str(REPO_ROOT)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "dgcrace:" in out
+
+
+# --------------------------------------------------------------------- #
+# waiver machinery rides along unchanged                                 #
+# --------------------------------------------------------------------- #
+
+def test_inline_waiver_suppresses_race_rule():
+    src = _PRE_FIX_SUPERVISOR.replace(
+        "self.launches += 1",
+        "self.launches += 1  # dgclint: ok[thread-shared-state]")
+    findings = race_lint_source(src, "waived.py")
+    assert not any(f.rule == "thread-shared-state"
+                   and "launches" in f.message for f in findings)
+
+
+def test_allowlist_matches_race_finding():
+    findings = race_lint_source(_PRE_FIX_SUPERVISOR, "pre_fix.py",
+                                allowlist=Allowlist([{
+                                    "rule": "thread-shared-state",
+                                    "file": "pre_fix.py",
+                                    "contains": "self.launches",
+                                    "reason": "test"}]))
+    waived = [f for f in findings if f.allowed]
+    assert waived and all("launches" in f.snippet for f in waived)

@@ -1,0 +1,213 @@
+"""Torn-read property tests: every tolerant reader in the coordination
+protocols, against truncation at EVERY byte boundary.
+
+The crash model (dgcmc, docs/ANALYSIS.md §Layer 4) says a reader of a
+rename-atomic artifact can only ever see a complete old or complete new
+file — but readers must ALSO survive the states a non-atomic writer or
+a torn filesystem could leave, because that is exactly the regression
+the model checker exists to catch. Contract per reader: a proper prefix
+of a valid artifact yields None (or the documented fallback), never an
+exception and never a partial payload."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dgc_tpu.resilience import surgery
+from dgc_tpu.serving import protocol
+from dgc_tpu.telemetry import sink
+
+
+def _assert_none_at_every_truncation(path, reader, full_value):
+    """reader(path) must be None for every proper prefix of the file and
+    ``full_value`` for the complete file."""
+    data = open(path, "rb").read()
+    assert len(data) > 2
+    for k in range(len(data)):
+        with open(path, "wb") as f:
+            f.write(data[:k])
+        got = reader()
+        assert got is None, f"truncation at byte {k}/{len(data)}: {got!r}"
+    with open(path, "wb") as f:
+        f.write(data)
+    assert reader() == full_value
+
+
+# --------------------------------------------------------------------- #
+# serving/protocol.py                                                    #
+# --------------------------------------------------------------------- #
+
+def test_read_json_none_at_every_truncation(tmp_path):
+    payload = {"base_version": 3, "latest_seq": 7, "digests": {"3:7": "d"}}
+    path = str(tmp_path / "x.json")
+    protocol.write_json_atomic(path, payload)
+    _assert_none_at_every_truncation(
+        path, lambda: protocol.read_json(path), payload)
+
+
+def test_read_manifest_none_at_every_truncation(tmp_path):
+    payload = {"spec": {"ratio": 0.5}, "base_version": 1, "latest_seq": 0}
+    protocol.write_json_atomic(
+        os.path.join(str(tmp_path), protocol.MANIFEST), payload)
+    _assert_none_at_every_truncation(
+        os.path.join(str(tmp_path), protocol.MANIFEST),
+        lambda: protocol.read_manifest(str(tmp_path)), payload)
+
+
+def test_read_resync_request_none_at_every_truncation(tmp_path):
+    req = protocol.request_resync(str(tmp_path), "stale_replica",
+                                  replicas=["a", "b"])
+    path = os.path.join(str(tmp_path), protocol.RESYNC_REQUEST)
+    _assert_none_at_every_truncation(
+        path, lambda: protocol.read_resync_request(str(tmp_path)), req)
+
+
+def test_load_npz_none_at_every_truncation(tmp_path):
+    path = str(tmp_path / "delta.npz")
+    arrays = {"values": np.arange(6, dtype=np.float32),
+              "idx": np.array([1, 3, 5], np.int32)}
+    protocol.save_npz_atomic(path, arrays)
+    data = open(path, "rb").read()
+    for k in range(len(data)):
+        with open(path, "wb") as f:
+            f.write(data[:k])
+        assert protocol.load_npz(path) is None, f"byte {k}/{len(data)}"
+    with open(path, "wb") as f:
+        f.write(data)
+    out = protocol.load_npz(path)
+    assert out is not None
+    np.testing.assert_array_equal(out["values"], arrays["values"])
+    np.testing.assert_array_equal(out["idx"], arrays["idx"])
+
+
+def test_load_npz_missing_is_gap_not_error(tmp_path):
+    assert protocol.load_npz(str(tmp_path / "absent.npz")) is None
+
+
+# --------------------------------------------------------------------- #
+# resilience/surgery.py                                                  #
+# --------------------------------------------------------------------- #
+
+def test_read_order_none_at_every_truncation(tmp_path):
+    path = str(tmp_path / surgery.ORDER_FILE)
+    surgery.publish_order(path, "straggler", 2, step=11)
+    full = surgery.read_order(path)
+    assert full and full["verdict"] == "straggler" and full["target"] == 2
+    _assert_none_at_every_truncation(
+        path, lambda: surgery.read_order(path), full)
+
+
+def test_read_exit_record_none_at_every_truncation(tmp_path):
+    path = str(tmp_path / surgery.EXIT_RECORD)
+    agreement = surgery.Agreement(excise=True, target=1,
+                                  verdict="straggler")
+    surgery.write_exit_record(path, agreement, world=4, process_index=0,
+                              step=9)
+    full = surgery.read_exit_record(path)
+    assert full and full["world"] == 4 and full["target"] == 1
+    _assert_none_at_every_truncation(
+        path, lambda: surgery.read_exit_record(path), full)
+
+
+# --------------------------------------------------------------------- #
+# telemetry/sink.py — append-tail-torn: prefix survives, never partial   #
+# --------------------------------------------------------------------- #
+
+def test_read_run_tolerant_prefix_at_every_truncation(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    app = sink.JsonlAppender(path)
+    from dgc_tpu.telemetry import registry
+    app.write({"schema": registry.SCHEMA,
+               "version": registry.SCHEMA_VERSION, "run": "t"})
+    for i in (1, 2, 3):
+        app.write({"kind": "step", "i": i})
+    app.close()
+    data = open(path, "rb").read()
+    header_len = data.index(b"\n") + 1
+    for k in range(len(data) + 1):
+        with open(path, "wb") as f:
+            f.write(data[:k])
+        if k < header_len - 1:
+            # a torn header is an unreadable FILE by contract — a typed
+            # error, never a misparse (k == header_len - 1 only drops
+            # the newline: the header json is complete and readable)
+            with pytest.raises(ValueError):
+                sink.read_run_tolerant(path)
+            continue
+        header, records, skipped = sink.read_run_tolerant(path)
+        ids = [r["i"] for r in records]
+        # complete-record prefix only; the torn tail is counted, not
+        # surfaced, and never parsed into a partial record
+        assert ids == [1, 2, 3][:len(ids)], f"byte {k}: {ids}"
+        assert all(set(r) == {"kind", "i"} for r in records)
+    header, records, skipped = sink.read_run_tolerant(path)
+    assert [r["i"] for r in records] == [1, 2, 3] and skipped == 0
+
+
+# --------------------------------------------------------------------- #
+# training/checkpoint.py — pointer torn at any byte => scan fallback     #
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def saved_manager(tmp_path_factory):
+    from dgc_tpu.training.checkpoint import CheckpointManager
+    d = str(tmp_path_factory.mktemp("ckpt"))
+    mgr = CheckpointManager(d, keep=3)
+    for epoch in (0, 1):
+        state = {"w": np.arange(4, dtype=np.float32) + epoch,
+                 "m": np.full((3,), float(epoch), np.float32)}
+        mgr.save(epoch, state, {"acc": 0.5 + epoch})
+    return mgr
+
+
+def test_latest_epoch_none_at_every_truncation(saved_manager):
+    mgr = saved_manager
+    meta = mgr._meta_path()
+    data = open(meta, "rb").read()
+    assert mgr.latest_epoch() == 1
+    for k in range(len(data)):
+        with open(meta, "wb") as f:
+            f.write(data[:k])
+        assert mgr.latest_epoch() is None, f"byte {k}/{len(data)}"
+    with open(meta, "wb") as f:
+        f.write(data)
+    assert mgr.latest_epoch() == 1
+
+
+def test_restore_falls_back_past_torn_pointer(saved_manager):
+    mgr = saved_manager
+    meta = mgr._meta_path()
+    data = open(meta, "rb").read()
+    template = {"w": np.zeros(4, np.float32), "m": np.zeros(3, np.float32)}
+    with open(meta, "wb") as f:
+        f.write(data[:len(data) // 2])   # torn pointer
+    try:
+        out = mgr.restore(template)
+        assert out is not None
+        state, epoch, meters = out
+        # the kept-epoch scan still finds the newest COMPLETE epoch
+        assert epoch == 1
+        np.testing.assert_array_equal(
+            np.asarray(state["w"]), np.arange(4, dtype=np.float32) + 1)
+    finally:
+        with open(meta, "wb") as f:
+            f.write(data)
+
+
+def test_restore_falls_back_past_torn_meters(saved_manager):
+    mgr = saved_manager
+    meters_path = os.path.join(mgr.directory, "e1", "meters.json")
+    data = open(meters_path, "rb").read()
+    template = {"w": np.zeros(4, np.float32), "m": np.zeros(3, np.float32)}
+    with open(meters_path, "wb") as f:
+        f.write(data[:len(data) // 2])   # torn meters in the newest epoch
+    try:
+        out = mgr.restore(template)
+        assert out is not None
+        _state, epoch, _meters = out
+        assert epoch == 0                # fell back, did not raise
+    finally:
+        with open(meters_path, "wb") as f:
+            f.write(data)
