@@ -890,6 +890,118 @@ class TelemetryStreamScenario(Scenario):
         return []
 
 
+class SchedulerLedgerScenario(Scenario):
+    """scheduler-ledger: GangScheduler's atomic queue snapshot + append-
+    only grant ledger vs read_queue / read_grant_ledger. The writer
+    drives a real scheduler (fake clock) through admit → grant → shrunk →
+    completed, so every crash point lands between a ledger append and
+    its queue-snapshot publish; the retry models a restarted scheduler,
+    whose ctor must resume the durable seq (SEQ-MONOTONIC across
+    incarnations). Under ``torn_tail`` a strict line reader substitutes,
+    modeling a consumer that treats a torn ledger tail as fatal."""
+
+    name = "scheduler-ledger"
+
+    def __init__(self, mutate: Optional[str] = None):
+        self._mutate = mutate
+
+    def setup(self, root: str) -> None:
+        # a prior scheduler session's durable head: one admit, already
+        # on disk before the sandboxed writer runs
+        from dgc_tpu.control.scheduler import GangScheduler
+        os.makedirs(root, exist_ok=True)
+        s = GangScheduler(4, root=root, clock=lambda: 100.0)
+        s.admit("warm", 1, priority=0, now=100.0)
+        s.close()
+
+    def writer(self, root: str) -> None:
+        from dgc_tpu.control.scheduler import GangScheduler
+        s = GangScheduler(4, root=root, clock=lambda: 101.0)
+        s.admit("alpha", 2, priority=0, now=101.0)
+        s.admit("beta", 1, priority=1, now=102.0)
+        s.tick(now=103.0)               # grants beta, then alpha
+        s.shrunk("alpha", by=1, now=104.0)
+        s.completed("beta", now=105.0)
+        s.close()
+
+    def _read_ledger(self, root: str):
+        from dgc_tpu.control import scheduler as sched
+        if self._mutate == "torn_tail":
+            # strict substitute: json.loads every line, torn tail raises
+            records = []
+            with open(os.path.join(root, sched.SCHED_GRANTS)) as f:
+                for ln in f:
+                    if ln.strip():
+                        records.append(json.loads(ln))
+            return records
+        return sched.read_grant_ledger(root)[0]
+
+    def check_crash(self, root: str) -> List[str]:
+        from dgc_tpu.control import scheduler as sched
+        out: List[str] = []
+        try:
+            snap = sched.read_queue(root)
+        except Exception as e:   # noqa: BLE001 - the invariant is "never raises"
+            out.append(f"QUEUE-COMPLETE: read_queue raised {e!r}")
+            snap = None
+        if snap is None:
+            # setup published a complete durable snapshot before the
+            # writer ran; an unreadable one means the head was LOST
+            # (exactly the drop_fsync hazard: replace of unsynced bytes)
+            out.append("QUEUE-COMPLETE: snapshot unreadable although a "
+                       "complete one existed before the publish")
+        else:
+            if (not isinstance(snap.get("total"), int)
+                    or not isinstance(snap.get("queue"), list)
+                    or not isinstance(snap.get("holdings"), dict)):
+                out.append(f"QUEUE-COMPLETE: partial snapshot {snap}")
+            elif not 0 <= snap.get("free", -1) <= snap["total"]:
+                out.append("QUEUE-COMPLETE: free outside [0, total]: "
+                           f"{snap}")
+        try:
+            records = self._read_ledger(root)
+        except Exception as e:   # noqa: BLE001 - strict reader models the hazard
+            out.append("LEDGER-TAIL-PREFIX: ledger reader raised on a "
+                       f"torn tail past a durable head: {e!r}")
+            return out
+        prev_seq = 0
+        for rec in records:
+            seq = rec.get("seq")
+            if not isinstance(seq, int) or seq <= prev_seq:
+                out.append(f"SEQ-MONOTONIC: seq {seq} after {prev_seq} "
+                           "— the surviving prefix is not the true "
+                           "transition history")
+                break
+            prev_seq = seq
+            if rec.get("held", -1) + rec.get("free", -1) \
+                    != rec.get("total", -2):
+                out.append("SLOT-CONSERVATION: held + free != total in "
+                           f"intact record {rec}")
+                break
+        return out
+
+    def retry(self, root: str) -> None:
+        self.writer(root)
+
+    def check_final(self, root: str) -> List[str]:
+        from dgc_tpu.control import scheduler as sched
+        out = self.check_crash(root)
+        # a completed (uncrashed) writer pass always leaves a readable
+        # snapshot and its full transition trail on the ledger
+        if sched.read_queue(root) is None:
+            out.append("QUEUE-COMPLETE: no readable snapshot after a "
+                       "completed writer pass")
+        try:
+            events = [r.get("event") for r in self._read_ledger(root)]
+        except Exception:   # noqa: BLE001 - already reported by check_crash
+            return out
+        for needed in ("admit", "grant", "shrunk", "completed"):
+            if needed not in events:
+                out.append(f"SEQ-MONOTONIC: completed transition "
+                           f"{needed!r} missing from the ledger trail")
+        return out
+
+
 def scenarios(mutate: Optional[str] = None,
               fast: bool = False) -> List[Scenario]:
     """All protocol scenarios, in protospec order. ``fast`` drops the
@@ -901,6 +1013,7 @@ def scenarios(mutate: Optional[str] = None,
         CohortLedgerScenario(),
         FabricScenario(),
         TelemetryStreamScenario(mutate=mutate),
+        SchedulerLedgerScenario(mutate=mutate),
     ]
     if not fast:
         out.insert(1, CheckpointScenario())

@@ -260,6 +260,44 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
         legal_orders="records are observed in append order; only the "
                      "unsynced tail may be lost",
     ),
+    ProtocolSpec(
+        name="scheduler-ledger",
+        files=(
+            FileSpec("sched_queue.json", RENAME_ATOMIC,
+                     "control.scheduler.GangScheduler._write_queue_locked "
+                     "(serving.protocol.write_json_atomic)",
+                     ("control.scheduler.read_queue",)),
+            FileSpec("sched_grants.jsonl", APPEND_TAIL_TORN,
+                     "control.scheduler.GangScheduler._record_locked "
+                     "(telemetry.sink.JsonlAppender.write)",
+                     ("control.scheduler.read_grant_ledger",)),
+        ),
+        invariants={
+            "QUEUE-COMPLETE": "read_queue returns a complete queue + "
+                              "holdings snapshot or None — a torn or "
+                              "crashed-mid-publish snapshot degrades to "
+                              "'no snapshot', never garbage (a garbled "
+                              "queue could double-grant a slot)",
+            "SLOT-CONSERVATION": "every intact grant-ledger record "
+                                 "carries held + free == total — at "
+                                 "every crash point the slot accounting "
+                                 "balances (an admit, grant, shrink, or "
+                                 "completion can move seats but never "
+                                 "mint or leak one)",
+            "SEQ-MONOTONIC": "ledger records are observed in strictly "
+                             "increasing seq order — the tolerant "
+                             "reader's surviving prefix is the true "
+                             "transition history, so grant latency and "
+                             "preempt audits replay faithfully",
+            "LEDGER-TAIL-PREFIX": "a crash may tear only the final "
+                                  "ledger line; read_grant_ledger skips "
+                                  "and counts it, never raises, never "
+                                  "yields a partial record",
+        },
+        legal_orders="admit precedes grant for a name; preempt precedes "
+                     "shrunk for a victim; only the unsynced ledger tail "
+                     "may be lost",
+    ),
 )
 
 PROTOCOLS_BY_NAME: Dict[str, ProtocolSpec] = {p.name: p for p in PROTOCOLS}

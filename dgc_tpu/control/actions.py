@@ -20,7 +20,9 @@ from dgc_tpu.control.supervisor import Supervisor, parse_env_file
 
 __all__ = ["publish_env", "default_cohort_planner", "act_restart",
            "act_elastic_relaunch", "act_quarantine", "act_adapt",
-           "act_excise", "act_readmit", "act_resync", "ACTIONS", "execute"]
+           "act_excise", "act_readmit", "act_resync", "act_admit",
+           "act_grant", "act_preempt_to_grant", "act_grow", "ACTIONS",
+           "execute"]
 
 
 def publish_env(path: str, updates: Dict[str, str]) -> Dict[str, str]:
@@ -220,6 +222,102 @@ def act_resync(sup: Optional[Supervisor], evidence: Dict,
             "request": req}
 
 
+def act_admit(sup: Optional[Supervisor], evidence: Dict,
+              enqueue=None, **_kw) -> Dict:
+    """Accept work into the gang scheduler's queue (control.scheduler):
+    a whole queued gang, or — when fired by the autoscale rule — one
+    extra seat for a healthy running gang. ``enqueue`` is plane-provided
+    (it closes over the scheduler and the gang identity); the action
+    itself is the audit point. Works without a live Supervisor — the
+    queued gang has no child yet."""
+    if enqueue is None:
+        return {"admitted": False, "error": "no scheduler wired"}
+    rec = enqueue()
+    out: Dict = {"admitted": not (rec or {}).get("duplicate", False)}
+    if isinstance(rec, dict):
+        out.update({k: rec[k] for k in ("kind", "slots", "priority",
+                                        "queue_depth", "duplicate")
+                    if k in rec})
+    return out
+
+
+def act_grant(sup: Optional[Supervisor], evidence: Dict,
+              launcher=None, **_kw) -> Dict:
+    """Assign granted slots: boot the queued gang's supervisors (or the
+    grow seat) under the granted cohort spec. ``launcher`` is
+    plane-provided; the grant decision's wait accounting rides the
+    evidence so queue latency is attributable per grant."""
+    if launcher is None:
+        return {"launched": [], "error": "no launcher wired"}
+    return {"launched": list(launcher())}
+
+
+def act_preempt_to_grant(sup: Supervisor, evidence: Dict,
+                         env_updates: Optional[Dict[str, str]] = None,
+                         order_paths=None, **_kw) -> Dict:
+    """Shrink a lower-priority running gang to free slots for a starved
+    higher-priority admission: publish the excise order (verdict
+    ``preempt`` is not a surgery verdict, so it degrades to ``manual``)
+    into EVERY victim member's watch dir — the members fold it at their
+    next step boundary and take the exit-76 path — and publish the
+    shrunk cohort spec the survivors relaunch under. The elastic merge
+    at their restore conserves the excised seat's error-feedback mass;
+    the freed slot grants at the scheduler's next tick."""
+    from dgc_tpu.resilience import surgery as _surgery
+    result: Dict = {}
+    target = evidence.get("worker")
+    paths = list(order_paths or [])
+    if not paths and sup is not None and sup.watch:
+        paths = [os.path.join(sup.watch, _surgery.ORDER_FILE)]
+    if target is not None:
+        published_orders = []
+        for path in paths:
+            _surgery.publish_order(
+                path, "manual", int(target),
+                extra={"rule_fired": evidence.get("hits"),
+                       "beneficiary": evidence.get("beneficiary")})
+            published_orders.append(path)
+        result["order"] = {"paths": published_orders, "verdict": "manual",
+                           "target": int(target)}
+    updates = dict(env_updates or {})
+    if updates and sup is not None and sup.env_file:
+        merged = publish_env(sup.env_file, updates)
+        result.update(env_file=sup.env_file, published=updates,
+                      cohort_spec={k: merged[k] for k in sorted(merged)})
+    else:
+        result["published"] = {}
+    return result
+
+
+def act_grow(sup: Supervisor, evidence: Dict,
+             env_updates: Optional[Dict[str, str]] = None,
+             relauncher=None, cohort_restart=None, **_kw) -> Dict:
+    """Complete a granted elastic grow: clear any stale surgery order /
+    exit record (the grown cohort must not relaunch into last
+    preemption's verdict), publish the grown cohort spec, boot the new
+    seat's supervisor (``relauncher``), and restart the running members
+    (``cohort_restart``) so the 1:k split reshard deals the
+    error-feedback state onto the new worker at the next restore."""
+    from dgc_tpu.resilience import surgery as _surgery
+    result: Dict = {}
+    if sup is not None and sup.watch:
+        _surgery.clear_order(os.path.join(sup.watch, _surgery.ORDER_FILE))
+        _surgery.clear_order(os.path.join(sup.watch,
+                                          _surgery.EXIT_RECORD))
+    updates = dict(env_updates or {})
+    if updates and sup is not None and sup.env_file:
+        merged = publish_env(sup.env_file, updates)
+        result.update(env_file=sup.env_file, published=updates,
+                      cohort_spec={k: merged[k] for k in sorted(merged)})
+    else:
+        result["published"] = {}
+    if relauncher is not None:
+        result["launched"] = list(relauncher())
+    if cohort_restart is not None:
+        result["cohort_restarted"] = list(cohort_restart())
+    return result
+
+
 #: action name (registry.CONTROL_ACTIONS) -> implementation
 ACTIONS = {
     "restart": act_restart,
@@ -229,6 +327,10 @@ ACTIONS = {
     "excise": act_excise,
     "readmit": act_readmit,
     "resync": act_resync,
+    "admit": act_admit,
+    "grant": act_grant,
+    "preempt_to_grant": act_preempt_to_grant,
+    "grow": act_grow,
 }
 
 

@@ -30,6 +30,7 @@ each run dir and the fleet root for the monitor's COHORT line and the
 ``dgc_cohort_size`` / ``dgc_pool_free`` gauges.
 """
 
+import collections
 import json
 import os
 import subprocess
@@ -39,6 +40,7 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 from dgc_tpu.control import actions as _actions
 from dgc_tpu.control.rules import Rule, RuleEngine
+from dgc_tpu.control.scheduler import GangScheduler
 from dgc_tpu.control.supervisor import Supervisor, parse_env_file
 from dgc_tpu.telemetry import registry
 from dgc_tpu.telemetry.sink import JsonlAppender
@@ -77,6 +79,9 @@ class RunSpec(NamedTuple):
     #: supervisor-side hang escalation (SIGKILL past a stale heartbeat)
     hang_timeout: Optional[float] = None
     heartbeat: Optional[str] = None
+    #: gang-scheduler priority (higher grants first; ties FIFO by admit
+    #: time) — only read when the plane has a GangScheduler wired
+    priority: int = 0
 
 
 class DevicePool:
@@ -92,6 +97,12 @@ class DevicePool:
     def __init__(self, slots: Dict[str, int]):
         self.slots = {n: int(c) for n, c in slots.items()}
         self.state: Dict[str, str] = {n: "active" for n in self.slots}
+
+    def add(self, name: str, slots: int = 1) -> None:
+        """Register (or grow) a run's holding as active — the gang
+        scheduler deals seats in as grants execute."""
+        self.slots[name] = self.slots.get(name, 0) + int(slots)
+        self.state[name] = "active"
 
     def quarantine(self, name: str) -> None:
         if self.state.get(name) == "active":
@@ -130,7 +141,8 @@ class ControlPlane:
                  rules: Optional[Sequence[Rule]] = None,
                  interval: float = 5.0, events_out: Optional[str] = None,
                  cohort_planner: Optional[Callable] = None,
-                 collect: Optional[Callable] = None):
+                 collect: Optional[Callable] = None,
+                 scheduler: Optional[GangScheduler] = None):
         names = [s.name for s in specs]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate run names in fleet: {names}")
@@ -156,6 +168,19 @@ class ControlPlane:
         self.ticks = 0
         self._started = False
         self._sleep = threading.Event()
+        # gang scheduling (docs/RESILIENCE.md §Scheduler): the scheduler
+        # loop thread only *decides* (appends to the deque); every
+        # mutation of supervisors/pool/stream happens on the tick thread
+        # when the decisions drain — one writer, no cross-thread races
+        self.scheduler = scheduler
+        self._gangs: Dict[str, Dict] = {}        # gang -> meta
+        self._gang_specs: Dict[str, List[RunSpec]] = {}
+        self._gang_of: Dict[str, str] = {}       # member run -> gang
+        self._gang_completed: set = set()
+        self._preempt_watch: Dict[str, str] = {}  # victim gang -> seat
+        self._sched_decisions: "collections.deque" = collections.deque()
+        self._sched_stop = threading.Event()
+        self._sched_thread: Optional[threading.Thread] = None
         for spec in specs:
             os.makedirs(spec.run_dir, exist_ok=True)
             self.specs[spec.name] = spec
@@ -185,6 +210,20 @@ class ControlPlane:
     def _plane_event(self, kind: str, **fields) -> None:
         self.stream.write(dict(fields, event=kind, t=time.time()))
 
+    def _audit(self, run: str, run_id: str, rule: str, action: str,
+               evidence: Dict, result: Dict) -> Dict:
+        """One schema-checked ``control_action`` record onto the fleet
+        stream + the in-memory trail. EVERY mutation the plane makes —
+        rule-fired remediations and scheduler transitions alike — funnels
+        through here, so the audit trail is the whole story."""
+        rec = {"event": "control_action", "run": run, "run_id": run_id,
+               "rule": rule, "action": action, "evidence": evidence,
+               "result": result, "t": time.time()}
+        registry.validate_control_action(rec)
+        self.stream.write(rec)
+        self.actions.append(rec)
+        return rec
+
     # ------------------------------------------------------------------ #
     # lifecycle                                                          #
     # ------------------------------------------------------------------ #
@@ -204,6 +243,11 @@ class ControlPlane:
                 name=f"dgc-control-{name}", daemon=True)
             self._threads[name] = t
             t.start()
+        if self.scheduler is not None and self._sched_thread is None:
+            t = threading.Thread(target=self._sched_loop,
+                                 name="dgc-sched", daemon=True)
+            self._sched_thread = t
+            t.start()
 
     def _supervise(self, name: str, sup: Supervisor) -> None:
         # plane threads must not touch signal handlers (main-thread-only)
@@ -211,6 +255,17 @@ class ControlPlane:
 
     def alive(self) -> bool:
         return any(t.is_alive() for t in self._threads.values())
+
+    def _sched_live(self) -> bool:
+        """The fleet isn't done while grantable work is queued or a
+        decision is waiting to execute — :meth:`run` keeps ticking even
+        when no supervisor thread is up yet (a freshly-submitted fleet
+        has zero running members until its first grant)."""
+        return (self.scheduler is not None
+                and not self._sched_stop.is_set()
+                and (self.scheduler.pending() > 0
+                     or bool(self._sched_decisions)
+                     or bool(self._preempt_watch)))
 
     def poll(self) -> Dict[str, Dict]:
         """Per-run view: supervisor state, launches, last rc."""
@@ -222,9 +277,11 @@ class ControlPlane:
         }
 
     def stop(self) -> None:
-        """Stop every run (SIGTERM through the supervisors) and wake the
-        tick loop; the supervisors stop relaunching."""
-        for sup in self.supervisors.values():
+        """Stop every run (SIGTERM through the supervisors), stop the
+        scheduler pump, and wake the tick loop; the supervisors stop
+        relaunching and queued grants stop executing."""
+        self._sched_stop.set()
+        for sup in list(self.supervisors.values()):
             sup.request_stop()
         self._sleep.set()
 
@@ -331,6 +388,274 @@ class ControlPlane:
                 pass    # a full disk must not stop the control loop
 
     # ------------------------------------------------------------------ #
+    # gang scheduling (docs/RESILIENCE.md §Scheduler)                    #
+    # ------------------------------------------------------------------ #
+
+    def submit(self, name: str, specs: Sequence[RunSpec],
+               priority: int = 0, slots_max: Optional[int] = None,
+               grow_spec: Optional[Callable[[int], RunSpec]] = None) -> Dict:
+        """Queue a gang for admission: the member RunSpecs launch together
+        when the scheduler grants their slots (and not before). ``specs``
+        is ordered — member *i* is cohort seat *i*. ``grow_spec(seat)``
+        (optional) mints the RunSpec for an elastic-grow seat; without it
+        the gang never grows past its submitted size. ``slots_max`` caps
+        autoscale growth (default: the submitted size, i.e. no growth).
+        The admission itself is an audited ``control_action``."""
+        if self.scheduler is None:
+            raise RuntimeError("ControlPlane has no GangScheduler wired")
+        specs = list(specs)
+        if not specs:
+            raise ValueError(f"gang {name!r} has no member specs")
+        for s in specs:
+            if s.name in self.specs or s.name in self._gang_of:
+                raise ValueError(f"duplicate run name {s.name!r}")
+        if name in self._gangs:
+            raise ValueError(f"duplicate gang name {name!r}")
+        slots = sum(s.slots for s in specs)
+        self._gangs[name] = {
+            "members": [s.name for s in specs], "priority": int(priority),
+            "slots_max": int(slots_max) if slots_max is not None else slots,
+            "grow_spec": grow_spec}
+        self._gang_specs[name] = specs
+        for s in specs:
+            self._gang_of[s.name] = name
+        evidence = {"kind": "submit", "gang": name, "slots": slots,
+                    "priority": int(priority),
+                    "members": [s.name for s in specs]}
+        result = _actions.execute(
+            "admit", None, evidence,
+            enqueue=lambda: self.scheduler.admit(
+                name, slots=slots, priority=int(priority), kind="launch"))
+        return self._audit(name, f"queued:{name}", "scheduler-admit",
+                           "admit", evidence, result)
+
+    def _admit_grow(self, member: str) -> Dict:
+        """The autoscale rule's enqueue hook: map the healthy run back to
+        its gang and queue ONE extra seat at the gang's priority. The
+        scheduler's duplicate check keeps a flapping rule from stacking
+        requests; ``slots_max`` is enforced both here and (cheaper) in
+        the detector's evidence gate."""
+        gang = self._gang_of.get(member)
+        meta = self._gangs.get(gang) if gang else None
+        if meta is None:
+            return {"duplicate": True, "error": "not a gang member"}
+        if meta.get("grow_spec") is None:
+            return {"duplicate": True, "error": "gang has no grow_spec"}
+        holding = self.scheduler.holding(gang) or {}
+        if int(holding.get("slots", 0)) >= meta["slots_max"]:
+            return {"duplicate": True, "error": "gang at slots_max"}
+        return self.scheduler.admit(gang, slots=1,
+                                    priority=meta["priority"], kind="grow")
+
+    def _register_and_start(self, spec: RunSpec) -> None:
+        """Late-bound run registration: a granted gang member gets its
+        supervisor + thread only when the grant executes."""
+        os.makedirs(spec.run_dir, exist_ok=True)
+        self.specs[spec.name] = spec
+        sup = self._make_supervisor(spec)
+        self.supervisors[spec.name] = sup
+        self._rcs[spec.name] = None
+        t = threading.Thread(target=self._supervise, args=(spec.name, sup),
+                             name=f"dgc-control-{spec.name}", daemon=True)
+        self._threads[spec.name] = t
+        if self._started:
+            t.start()
+
+    def _sched_loop(self) -> None:
+        """Scheduler pump thread ("dgc-sched"): periodically tick the
+        gang scheduler and queue its decisions. It NEVER executes them —
+        launches, order files, and env publishes all happen on the tick
+        thread when :meth:`_drain_sched_decisions` pops the deque, so
+        supervisor/pool/stream state keeps a single writer."""
+        while not self._sched_stop.wait(self.interval):
+            try:
+                self._sched_decisions.extend(self.scheduler.tick())
+            except Exception:
+                pass    # a scheduler hiccup must not kill the pump
+
+    def _drain_sched_decisions(self) -> List[Dict]:
+        """Execute every queued scheduler decision (plus a synchronous
+        scheduler tick, so a plane tick never waits a pump period for an
+        obvious grant). Returns the audited ``control_action`` records."""
+        if self._sched_stop.is_set():
+            self._sched_decisions.clear()   # no launches after stop
+            return []
+        try:
+            self._sched_decisions.extend(self.scheduler.tick())
+        except Exception:
+            pass
+        fired: List[Dict] = []
+        while self._sched_decisions:
+            d = self._sched_decisions.popleft()
+            try:
+                rec = self._exec_decision(d)
+            except Exception as e:
+                self._plane_event("sched_decision_error", decision=dict(d),
+                                  error=repr(e))
+                continue
+            if rec is not None:
+                fired.append(rec)
+        return fired
+
+    def _exec_decision(self, d: Dict) -> Optional[Dict]:
+        if d.get("decision") == "grant":
+            if d.get("kind") == "grow":
+                return self._exec_grant_grow(d)
+            return self._exec_grant_launch(d)
+        if d.get("decision") == "preempt_to_grant":
+            return self._exec_preempt(d)
+        return None
+
+    def _exec_grant_launch(self, d: Dict) -> Optional[Dict]:
+        """A queued gang got its slots: boot every member's supervisor
+        and deal their seats into the pool ledger as active."""
+        gang = d["name"]
+        specs = self._gang_specs.get(gang)
+        if specs is None:
+            return None
+
+        def launcher() -> List[str]:
+            launched = []
+            for spec in specs:
+                if spec.name in self.supervisors:
+                    continue    # idempotent: a replayed grant is a no-op
+                self._register_and_start(spec)
+                self.pool.add(spec.name, spec.slots)
+                launched.append(spec.name)
+            return launched
+
+        evidence = dict(d, kind="grant_launch", gang=gang)
+        result = _actions.execute("grant", None, evidence,
+                                  launcher=launcher)
+        sup = self.supervisors.get(self._gangs[gang]["members"][0])
+        run_id = sup.run_id if sup is not None else f"gang:{gang}"
+        return self._audit(gang, run_id, "scheduler-grant", "grant",
+                           evidence, result)
+
+    def _exec_grant_grow(self, d: Dict) -> Optional[Dict]:
+        """A granted grow seat: mint the seat's RunSpec, publish the
+        grown cohort spec, boot the seat, and restart the running members
+        so the 1:k split reshard deals the error-feedback state onto the
+        new worker (the ``grow`` action does the surgery-order hygiene)."""
+        gang = d["name"]
+        meta = self._gangs.get(gang)
+        if meta is None or meta.get("grow_spec") is None:
+            return None
+        sup = self.supervisors.get(meta["members"][0])
+        if sup is None:
+            return None
+        world = self._spec_world(meta["members"][0])
+        if world is None:
+            world = len(meta["members"])
+        seat = world
+        spec = meta["grow_spec"](seat)
+
+        def relauncher() -> List[str]:
+            meta["members"].append(spec.name)
+            self._gang_specs[gang].append(spec)
+            self._gang_of[spec.name] = gang
+            self._register_and_start(spec)
+            self.pool.add(spec.name, spec.slots)
+            return [spec.name]
+
+        evidence = dict(d, kind="grant_grow", gang=gang, seat=seat,
+                        world=world + 1)
+        result = _actions.execute(
+            "grow", sup, evidence,
+            env_updates={"JAX_NUM_PROCESSES": str(world + 1)},
+            relauncher=relauncher,
+            cohort_restart=lambda: self._restart_cohort(spec.name))
+        return self._audit(gang, sup.run_id, "scheduler-grow", "grow",
+                           evidence, result)
+
+    def _exec_preempt(self, d: Dict) -> Optional[Dict]:
+        """Shrink the victim gang by one seat through the cohort-surgery
+        excise path: the order file lands in EVERY member's watch dir,
+        the target seat exits 76 and self-excises, survivors relaunch
+        under the shrunk spec, and the elastic merge folds the excised
+        seat's residual into a survivor — zero mass lost. The freed seat
+        grants to the beneficiary at a later tick (see
+        :meth:`_sched_bookkeeping`)."""
+        from dgc_tpu.resilience import surgery as _surgery
+        victim = d.get("victim")
+        vmeta = self._gangs.get(victim)
+        if vmeta is None:
+            return None
+        sup = self.supervisors.get(vmeta["members"][0])
+        if sup is None:
+            return None
+        world = self._spec_world(vmeta["members"][0])
+        if world is None:
+            world = len(vmeta["members"])
+        if world < 2:
+            return None     # the elastic merge needs a survivor
+        target = world - 1
+        seat_name = vmeta["members"][target] \
+            if target < len(vmeta["members"]) else vmeta["members"][-1]
+        order_paths = []
+        for m in vmeta["members"]:
+            msup = self.supervisors.get(m)
+            if msup is not None and msup.watch:
+                order_paths.append(
+                    os.path.join(msup.watch, _surgery.ORDER_FILE))
+        evidence = dict(d, kind="preempt", gang=victim, worker=target,
+                        world=world, beneficiary=d.get("name"))
+        result = _actions.execute(
+            "preempt_to_grant", sup, evidence,
+            env_updates={"JAX_NUM_PROCESSES": str(world - 1)},
+            order_paths=order_paths)
+        self._preempt_watch[victim] = seat_name
+        return self._audit(victim, sup.run_id, "scheduler-preempt",
+                           "preempt_to_grant", evidence, result)
+
+    def _sched_bookkeeping(self) -> None:
+        """Close the scheduler's feedback loops on the tick thread:
+        an excised preempt target frees its seat (``shrunk``), a gang
+        with a member winding down stops being a preemption target
+        (``mark_exiting``), and a fully-terminal gang returns all its
+        seats (``completed``)."""
+        for victim, seat in list(self._preempt_watch.items()):
+            sup = self.supervisors.get(seat)
+            if sup is None:
+                continue
+            if (sup.quarantined or "").startswith("excised:"):
+                self.scheduler.shrunk(
+                    victim, by=self.specs[seat].slots)
+                self._preempt_watch.pop(victim, None)
+                self._plane_event("sched_slot_freed", run=victim,
+                                  seat=seat, reason=sup.quarantined)
+        for gang, meta in self._gangs.items():
+            if gang in self._gang_completed:
+                continue
+            members = meta["members"]
+            if not all(m in self.supervisors for m in members):
+                continue    # not granted yet (or grow seat mid-boot)
+            if gang in self._preempt_watch:
+                continue    # shrink in flight; judge after it lands
+            def terminal(m: str) -> bool:
+                t = self._threads.get(m)
+                return (self._rcs.get(m) is not None
+                        and (t is None or not t.is_alive()))
+            if all(terminal(m) for m in members):
+                self.scheduler.completed(gang)
+                self._gang_completed.add(gang)
+            elif any(terminal(m) for m in members):
+                self.scheduler.mark_exiting(gang)
+
+    def _sched_snap(self, name: str, sched_state: Dict) -> Optional[Dict]:
+        """The per-run scheduler view injected as ``snap["sched"]`` for
+        the autoscale detector (rules.detect_autoscale)."""
+        gang = self._gang_of.get(name)
+        meta = self._gangs.get(gang) if gang else None
+        if meta is None:
+            return None
+        holding = self.scheduler.holding(gang) or {}
+        return {"gang": gang, "slots": int(holding.get("slots", 0)),
+                "slots_max": meta["slots_max"],
+                "free": sched_state.get("free", 0),
+                "pending": self.scheduler.pending()}
+
+    # ------------------------------------------------------------------ #
     # observe -> decide -> act                                           #
     # ------------------------------------------------------------------ #
 
@@ -340,7 +665,15 @@ class ControlPlane:
         now = time.monotonic() if now is None else now
         self.ticks += 1
         fired: List[Dict] = []
-        for name, sup in self.supervisors.items():
+        sched_state: Optional[Dict] = None
+        if self.scheduler is not None:
+            # execute queued scheduler decisions FIRST (they mutate the
+            # supervisor table; the per-run loop below must see a stable
+            # view), then close the shrink/exit feedback loops
+            fired.extend(self._drain_sched_decisions())
+            self._sched_bookkeeping()
+            sched_state = self.scheduler.snapshot()
+        for name, sup in list(self.supervisors.items()):
             quarantined = sup.quarantined is not None
             if quarantined:
                 # ledger: a quarantined run holds its slots until the
@@ -362,6 +695,10 @@ class ControlPlane:
             except Exception:
                 continue    # young/torn/missing run: no evidence yet
             snap = dict(snap, cohort=self._cohort_state(name))
+            if sched_state is not None:
+                sched_view = self._sched_snap(name, sched_state)
+                if sched_view is not None:
+                    snap["sched"] = sched_view
             for rule, evidence in self.engine.evaluate(name, snap, now):
                 if (quarantined and name in self._quarantine_audited
                         and rule.action != "readmit"):
@@ -375,15 +712,12 @@ class ControlPlane:
                         lambda _n=name: self._relaunch(_n)
                     kw["cohort_restart"] = \
                         lambda _n=name: self._restart_cohort(_n)
+                if rule.action == "admit":
+                    kw["enqueue"] = \
+                        lambda _n=name: self._admit_grow(_n)
                 result = _actions.execute(rule.action, sup, evidence, **kw)
-                rec = {"event": "control_action", "run": name,
-                       "run_id": sup.run_id, "rule": rule.name,
-                       "action": rule.action, "evidence": evidence,
-                       "result": result, "t": time.time()}
-                registry.validate_control_action(rec)
-                self.stream.write(rec)
-                self.actions.append(rec)
-                fired.append(rec)
+                fired.append(self._audit(name, sup.run_id, rule.name,
+                                         rule.action, evidence, result))
                 if rule.action in ("quarantine", "excise"):
                     if self.supervisors[name].quarantined is not None:
                         self._quarantine_audited.add(name)
@@ -399,16 +733,19 @@ class ControlPlane:
         control cycles pass — then the fleet is stopped). Returns the
         final :meth:`poll` view."""
         self.start()
-        while self.alive():
+        while self.alive() or self._sched_live():
             if max_ticks is not None and self.ticks >= max_ticks:
                 self.stop()
                 break
             self._sleep.wait(self.interval)
             self._sleep.clear()
             self.tick()
-        for t in self._threads.values():
+        for t in list(self._threads.values()):
             t.join(timeout=max(30.0, 2 * self.interval))
         self.tick()     # final pass: audit anything the exits revealed
+        if self._sched_thread is not None:
+            self._sched_stop.set()
+            self._sched_thread.join(timeout=max(30.0, 2 * self.interval))
         final = self.poll()
         self._plane_event("plane_stop", ticks=self.ticks,
                           actions=len(self.actions), runs=final)

@@ -33,7 +33,7 @@ from typing import Callable, Dict, NamedTuple, Optional, Tuple
 __all__ = ["Rule", "RuleEngine", "default_rules", "load_rules",
            "DETECTORS", "detect_desync", "detect_straggler",
            "detect_quarantine", "detect_cohort_shrink", "detect_excise",
-           "detect_readmit", "detect_stale_replica"]
+           "detect_readmit", "detect_stale_replica", "detect_autoscale"]
 
 
 class Rule(NamedTuple):
@@ -203,6 +203,40 @@ def detect_stale_replica(snap: Dict) -> Optional[Dict]:
     return ev
 
 
+def detect_autoscale(snap: Dict, max_straggler_share: float = 1.5) \
+        -> Optional[Dict]:
+    """A healthy run with headroom (the gang scheduler's injected
+    ``snap["sched"]`` view shows ``slots < slots_max``) that is making
+    throughput (the summary's rate lane) and is NOT straggler-bound —
+    giving a straggler-limited cohort another worker just adds another
+    waiter. Remediation: ``admit`` a one-seat grow request; the
+    scheduler grants it when slots free (preempting a lower-priority
+    gang if the priority gap says so)."""
+    sched = snap.get("sched") or {}
+    slots = sched.get("slots")
+    slots_max = sched.get("slots_max")
+    try:
+        slots, slots_max = int(slots), int(slots_max)
+    except (TypeError, ValueError):
+        return None
+    if slots < 1 or slots >= slots_max:
+        return None
+    rate = snap.get("steps_per_s")
+    try:
+        rate = float(rate)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(rate) or rate <= 0:
+        return None    # no throughput signal: don't scale blind
+    s = snap.get("summary") or {}
+    share = s.get("straggler_share")
+    if share is not None and math.isfinite(float(share)) \
+            and float(share) >= max_straggler_share:
+        return None    # straggler-bound: a new seat would just wait too
+    return {"kind": "autoscale", "slots": slots, "slots_max": slots_max,
+            "target_slots": slots + 1, "rate": rate}
+
+
 def default_rules() -> Tuple[Rule, ...]:
     """The shipped remediation table (docs/TELEMETRY.md §"Control plane").
     Order matters: quarantine outranks everything — a numerically dead
@@ -222,6 +256,8 @@ def default_rules() -> Tuple[Rule, ...]:
              min_hits=1, debounce_s=60.0, budget=2),
         Rule("stale-replica-resync", detect_stale_replica, "resync",
              min_hits=2, debounce_s=30.0, budget=4),
+        Rule("autoscale-admit", detect_autoscale, "admit",
+             min_hits=3, debounce_s=300.0, budget=2),
     )
 
 
@@ -234,6 +270,7 @@ DETECTORS: Dict[str, Callable[[Dict], Optional[Dict]]] = {
     "excise": detect_excise,
     "readmit": detect_readmit,
     "stale_replica": detect_stale_replica,
+    "autoscale": detect_autoscale,
 }
 
 #: the Rule fields a ``rules.toml`` table may set

@@ -59,6 +59,7 @@ from the control plane's thread:
 
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -161,6 +162,11 @@ class Supervisor:
         self.cohort = {k: os.environ.get(k) for k in COHORT_KEYS
                        if os.environ.get(k) is not None}
         self._events = JsonlAppender(events) if events else None
+        # decorrelated-jitter backoff state: the previous delay seeds the
+        # next draw's upper bound. Per-instance RNG so tests can seed it
+        # and a fleet of supervisors never shares a stream.
+        self._last_delay = 0.0
+        self._rng = random.Random()
         self._wake = threading.Event()
         # guards child/quarantined/shutting_down/launches/cohort — shared
         # between run(), the hang-watch thread, and cross-thread
@@ -324,6 +330,23 @@ class Supervisor:
     # the loop                                                           #
     # ------------------------------------------------------------------ #
 
+    def _next_delay(self, failures):
+        """Decorrelated-jitter backoff: the first retry waits exactly
+        ``backoff``; each later delay draws uniformly from
+        ``[backoff, min(3 * previous, backoff_max)]``. A correlated fleet
+        failure (one bad switch kills every child at once) then spreads
+        its relaunch storm out instead of hammering the coordinator in
+        exponential lockstep — same expected growth as doubling, none of
+        the synchronization. Checkpoint progress resets ``failures`` and
+        with it the spread."""
+        if failures <= 1:
+            self._last_delay = 0.0
+        lo = min(self.backoff, self.backoff_max)
+        hi = min(max(3.0 * self._last_delay, lo), self.backoff_max)
+        delay = self._rng.uniform(lo, hi) if hi > lo else lo
+        self._last_delay = delay
+        return delay
+
     def run(self, install_signals=None):
         """Supervise until the run ends; returns the final exit code.
         ``install_signals`` defaults to True only on the main thread
@@ -422,8 +445,7 @@ class Supervisor:
                 self.event("giveup", rc=rc, failures=failures,
                            retries=self.retries)
                 return rc
-            delay = min(self.backoff * (2 ** max(failures - 1, 0)),
-                        self.backoff_max)
+            delay = self._next_delay(failures)
             self.event("relaunch", rc=rc, elapsed=elapsed,
                        failures=failures, delay=delay,
                        progressed=progressed)

@@ -424,6 +424,32 @@ def render_openmetrics_fleet(fsnap: Dict) -> str:
             "dgc_control_actions",
             ("control-plane remediation actions fired per run", []))[1] \
             .extend((_labels(r), n) for r, n in sorted(counts.items()))
+    sched = fsnap.get("sched")
+    if sched:
+        if isinstance(sched.get("total"), int):
+            families.setdefault(
+                "dgc_sched_slots_total",
+                ("gang scheduler device-pool capacity in seats",
+                 []))[1].append(("", sched["total"]))
+            families.setdefault(
+                "dgc_sched_slots_free",
+                ("gang scheduler free seats", []))[1] \
+                .append(("", sched.get("free", 0)))
+        families.setdefault(
+            "dgc_sched_queue_depth",
+            ("gangs queued for admission (schedulable)", []))[1] \
+            .append(("", sched.get("queue_depth", 0)))
+        for gang, slots in sorted((sched.get("holdings") or {}).items()):
+            families.setdefault(
+                "dgc_sched_held_slots",
+                ("seats held per granted gang", []))[1] \
+                .append((_labels(gang), slots))
+        lat = sched.get("grant_latency")
+        if lat:
+            families.setdefault(
+                "dgc_sched_grant_latency_seconds",
+                ("median queue wait across grants", []))[1] \
+                .append(("", lat["median_s"]))
     return _render_families(families)
 
 
@@ -596,6 +622,38 @@ def read_control_events(fleet_root: str) -> List[Dict]:
     return out
 
 
+def collect_sched(fleet_root: str) -> Optional[Dict]:
+    """The gang scheduler's SCHED lane: queue snapshot + grant-ledger
+    stats from the scheduler-ledger protocol files under the fleet root
+    (control.scheduler). ``None`` when no scheduler ever ran here."""
+    # lazy import: the monitor must stay importable without the control
+    # plane package in degraded environments
+    from dgc_tpu.control import scheduler as _sched
+    snap = _sched.read_queue(fleet_root)
+    records, skipped = _sched.read_grant_ledger(fleet_root)
+    if snap is None and not records:
+        return None
+    out: Dict = {"queue_depth": 0, "ledger_records": len(records),
+                 "ledger_skipped": skipped}
+    if snap is not None:
+        total = snap.get("total")
+        queue = snap.get("queue") or []
+        # schedulable depth only (mirrors GangScheduler.pending): a
+        # permanently-parked entry must not read as a backlog
+        depth = sum(1 for e in queue
+                    if not isinstance(total, int)
+                    or int(e.get("slots", 0)) <= total)
+        out.update(total=total, free=snap.get("free"), queue_depth=depth,
+                   holdings={n: h.get("slots")
+                             for n, h in (snap.get("holdings")
+                                          or {}).items()},
+                   unschedulable=snap.get("unschedulable") or [])
+    lat = _sched.grant_latency_summary(records)
+    if lat is not None:
+        out["grant_latency"] = lat
+    return out
+
+
 def collect_fleet(fleet_root: str, *, rate_window: int = 50) -> Dict:
     """One snapshot of every run under a fleet root. Tolerant per run: a
     run whose telemetry cannot be read yields ``{"error": ...}`` instead
@@ -607,8 +665,12 @@ def collect_fleet(fleet_root: str, *, rate_window: int = 50) -> Dict:
         except (OSError, ValueError) as e:
             snaps[name] = {"run": path, "run_label": name,
                            "error": f"{type(e).__name__}: {e}"}
-    return {"root": fleet_root, "t_collect": time.time(), "runs": snaps,
-            "control": read_control_events(fleet_root)}
+    fsnap = {"root": fleet_root, "t_collect": time.time(), "runs": snaps,
+             "control": read_control_events(fleet_root)}
+    sched = collect_sched(fleet_root)
+    if sched is not None:
+        fsnap["sched"] = sched
+    return fsnap
 
 
 def rank_runs(fsnap: Dict) -> List[Dict]:
@@ -680,9 +742,26 @@ def render_fleet_status(fsnap: Dict) -> str:
     lines = [
         f"== dgc fleet control == {fsnap.get('root', '?')}",
         f"   {len(runs)} runs  {n_actions} control actions",
-        "   health  verdict     run           step    rate/s  launches  "
-        "notes",
     ]
+    sched = fsnap.get("sched")
+    if sched:
+        bits = [f"slots {sched.get('free', '?')}/{sched.get('total', '?')} "
+                f"free", f"queue {sched.get('queue_depth', 0)}"]
+        holdings = sched.get("holdings") or {}
+        if holdings:
+            bits.append("held " + " ".join(
+                f"{n}:{s}" for n, s in sorted(holdings.items())))
+        lat = sched.get("grant_latency")
+        if lat:
+            bits.append(f"grant p50 {lat['median_s']:.2f}s "
+                        f"max {lat['max_s']:.2f}s")
+        if sched.get("unschedulable"):
+            bits.append("UNSCHEDULABLE [" +
+                        ",".join(sched["unschedulable"]) + "]")
+        lines.append("   SCHED: " + "  ".join(bits))
+    lines.append(
+        "   health  verdict     run           step    rate/s  launches  "
+        "notes")
     for r in rank_runs(fsnap):
         if r["verdict"] == "unreadable":
             lines.append(f"   {r['score']:>6}  {r['verdict']:<10}  "

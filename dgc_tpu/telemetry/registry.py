@@ -170,6 +170,27 @@ CONTROL_ACTIONS: Tuple[MetricSpec, ...] = (
                "full base snapshot and replicas reload from it — the "
                "stale/gapped/divergent-replica remediation "
                "(dgc_tpu.serving)", better="lower"),
+    MetricSpec("admit", "action",
+               "accept a queued RunSpec (or a running run's grow request) "
+               "into the gang scheduler's queue (control.scheduler) — the "
+               "entry transition of the slot ledger; recorded so queue "
+               "residency is attributable end to end", better="lower"),
+    MetricSpec("grant", "action",
+               "assign freed device-pool slots to the queued run the "
+               "priority/health ranking puts first and launch (or grow) it "
+               "under the granted cohort spec — the scheduler's normal "
+               "dequeue transition", better="lower"),
+    MetricSpec("preempt_to_grant", "action",
+               "shrink a lower-priority run via the cohort-surgery excise "
+               "path (atomic order file, exit 76, elastic merge conserves "
+               "its error-feedback mass) to free slots for a higher-"
+               "priority queued run — the scheduler's starvation "
+               "remediation", better="lower"),
+    MetricSpec("grow", "action",
+               "complete a granted elastic grow: publish the grown cohort "
+               "spec, boot the new seat's supervisor, and restart the "
+               "cohort so the 1:k split reshard deals the error-feedback "
+               "state onto the new worker", better="lower"),
 )
 
 #: per-replica serving-stream health (dgc_tpu.serving, ISSUE 17). Each
@@ -258,6 +279,13 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
                "jaxpr liveness (dgcver donation pass, "
                "runs/analysis_report.json) — a static proxy for step HBM "
                "high-water", better="lower"),
+    MetricSpec("grant_latency_s", "scalar",
+               "median admit-to-grant latency over the gang scheduler's "
+               "grant ledger (control.scheduler) — how long queued work "
+               "waits for slots", better="lower"),
+    MetricSpec("sched_queue_depth", "scalar",
+               "gang-scheduler queue depth at collection time (pending "
+               "admissions not yet granted)", better="lower"),
 )
 
 

@@ -84,6 +84,15 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
     if isinstance(srv, dict) and isinstance(
             srv.get("wire_bytes_per_update"), (int, float)):
         out["wire_bytes_per_update"] = float(srv["wire_bytes_per_update"])
+    # gang-scheduler service metrics (lower is better; see registry) —
+    # median grant wait + schedulable backlog, as written by the t1.sh
+    # SCHED smoke or monitor.collect_sched
+    sch = obj.get("scheduler")
+    if isinstance(sch, dict):
+        if isinstance(sch.get("grant_latency_s"), (int, float)):
+            out["grant_latency_s"] = float(sch["grant_latency_s"])
+        if isinstance(sch.get("sched_queue_depth"), (int, float)):
+            out["sched_queue_depth"] = float(sch["sched_queue_depth"])
     return out
 
 

@@ -124,6 +124,18 @@ timeout -k 10 60 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --verify \
 # so layer 4 can only ever make the tier-1 gate marginally slower
 timeout -k 10 60 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --mc \
   && echo "MC_BUDGET=ok" || { echo "MC_BUDGET=FAIL"; rc=1; }
+# scheduler smoke (docs/RESILIENCE.md §Scheduler): fake-clock
+# starvation/fairness units — never-grantable gang parked without
+# head-of-line blocking, FIFO priority ties, exiting gangs skipped as
+# preemption victims, one preempt in flight per starved head — the
+# persisted scheduler-ledger (conservation per record, seq monotone
+# across restarts, tolerant readers), the monitor's SCHED lane, and the
+# plane-level gang grant/queue/complete lifecycle with trivial member
+# commands; the 3-run priority-inversion subprocess drill is slow-marked
+# and runs outside tier 1
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_scheduler.py \
+  -q -m fast -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "SCHED_SMOKE=ok" || { echo "SCHED_SMOKE=FAIL"; rc=1; }
 # dgclint gate (docs/ANALYSIS.md): AST lints over the tree + the
 # compiled-program contract suite + the dgcver jaxpr dataflow verifier
 # (collective-axis/dtype-flow/donation/ef-conservation over every pinned
@@ -131,7 +143,8 @@ timeout -k 10 60 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --mc \
 # nonzero on any un-allowlisted finding, broken step invariant (one
 # sparse exchange, telemetry compiles away, donation aliases,
 # barrier-free fused epilogue, error feedback conserves), or protocol
-# crash-safety violation
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --gate --verify --mc \
+# crash-safety violation — --race adds the host-concurrency lint over
+# the control plane's threaded paths (scheduler pump, supervisor loops)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m dgc_tpu.analysis --gate --verify --mc --race \
   && echo "ANALYSIS_GATE=ok" || { echo "ANALYSIS_GATE=FAIL"; rc=1; }
 exit $rc

@@ -1,0 +1,35 @@
+"""Clean twin: the real GangScheduler shape — one lock guards every
+piece of ledger state the pump thread and the tick-side callers share;
+decisions cross threads through a deque (its appends are atomic)."""
+import collections
+import threading
+
+
+class LockedScheduler:
+    def __init__(self, total):
+        self.total = total
+        self._lock = threading.Lock()
+        self._queue = []
+        self._held = 0
+        self._decisions = collections.deque()
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                for entry in list(self._queue):
+                    slots = entry["slots"]
+                    if slots <= self.total - self._held:
+                        self._queue.remove(entry)
+                        self._held = self._held + slots
+                        self._decisions.append(entry)
+
+    def admit(self, name, slots):
+        with self._lock:
+            self._queue.append({"name": name, "slots": slots})
+
+    def completed(self, slots):
+        with self._lock:
+            self._held = self._held - slots

@@ -1,0 +1,34 @@
+"""Seeded violation: a gang scheduler whose pump thread and tick-side
+callers mutate the queue/holdings ledger WITHOUT a lock — the hazard
+control.scheduler.GangScheduler is built to avoid (one lock around all
+ledger state; decisions cross threads in a deque).
+
+`# LINT: <rule-id>` marks the lines tests expect the race linter to
+flag (the emit site is the first unlocked write)."""
+import threading
+
+
+class UnlockedScheduler:
+    def __init__(self, total):
+        self.total = total
+        self._queue = []
+        self._held = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self):
+        # scheduler loop thread: grants mutate the ledger while admit()
+        # appends from the tick thread — a torn read double-grants a slot
+        while not self._stop.wait(0.01):
+            for entry in list(self._queue):
+                slots = entry["slots"]
+                if slots <= self.total - self._held:
+                    self._queue.remove(entry)
+                    self._held = self._held + slots  # LINT: thread-shared-state
+
+    def admit(self, name, slots):
+        self._queue.append({"name": name, "slots": slots})
+
+    def completed(self, slots):
+        self._held = self._held - slots

@@ -28,7 +28,7 @@ _SILENT = lambda s: None  # noqa: E731
 
 def test_protocol_specs_well_formed():
     classes = {RENAME_ATOMIC, WRITE_ONCE, APPEND_TAIL_TORN}
-    assert len(PROTOCOLS) == 7
+    assert len(PROTOCOLS) == 8
     for spec in PROTOCOLS:
         assert spec.files, spec.name
         assert spec.invariants, spec.name
@@ -180,6 +180,20 @@ def test_env_var_seeds_mutation(monkeypatch):
     monkeypatch.setenv("DGC_MC_MUTATE", "torn_tail")
     results = run_mc_suite(log=_SILENT, fast=True)
     assert any(v for n, v in results if n == "telemetry-stream")
+
+
+def test_torn_tail_reds_scheduler_ledger():
+    # the gang scheduler's grant ledger is append-tail-torn: swapping in
+    # a strict line reader must turn the gate red NAMING the protocol
+    # (scoped to the one scenario — the full-suite mutation sweep is
+    # already pinned per-mutation above; re-running all 8 here would
+    # only re-prove that at ~6s of tier-1 budget)
+    scn = [s for s in scenarios(mutate="torn_tail", fast=True)
+           if s.name == "scheduler-ledger"][0]
+    viols = explore(scn, log=_SILENT, mutate="torn_tail")
+    assert viols
+    assert all(v.startswith("scheduler-ledger @ ") for v in viols)
+    assert any("LEDGER-TAIL-PREFIX" in v for v in viols)
 
 
 def test_explore_reports_crash_context():

@@ -387,3 +387,63 @@ def test_control_plane_quarantines_nonfinite_run(tmp_path):
     om = monitor.render_openmetrics(snap)
     assert "dgc_flight_dump{" in om
     assert "dgc_guard_nonfinite_rate{" in om
+
+
+# --------------------------------------------------------------------- #
+# decorrelated-jitter backoff (pinned bounds)                           #
+# --------------------------------------------------------------------- #
+
+def _jitter_sup(backoff=2.0, backoff_max=30.0, seed=1234):
+    from dgc_tpu.control.supervisor import Supervisor
+    sup = Supervisor(["true"], backoff=backoff, backoff_max=backoff_max)
+    sup._rng.seed(seed)
+    return sup
+
+
+@pytest.mark.fast
+def test_backoff_first_retry_is_exactly_base():
+    # failures == 1 resets the spread: the first retry after a fresh
+    # failure streak waits exactly ``backoff``, deterministically
+    sup = _jitter_sup(backoff=2.0, backoff_max=30.0)
+    assert sup._next_delay(1) == 2.0
+    sup._next_delay(4)              # widen the spread ...
+    assert sup._next_delay(1) == 2.0    # ... progress resets it
+
+
+@pytest.mark.fast
+def test_backoff_jitter_bounds_pinned():
+    # every delay obeys backoff <= d <= backoff_max, and each draw's
+    # envelope is decorrelated: d_n <= min(3 * d_{n-1}, backoff_max)
+    for seed in range(20):
+        sup = _jitter_sup(backoff=2.0, backoff_max=30.0, seed=seed)
+        prev = sup._next_delay(1)
+        assert prev == 2.0
+        for failures in range(2, 12):
+            d = sup._next_delay(failures)
+            assert 2.0 <= d <= 30.0, (seed, failures, d)
+            assert d <= min(3.0 * prev, 30.0) + 1e-9, (seed, failures, d)
+            prev = d
+
+
+@pytest.mark.fast
+def test_backoff_jitter_decorrelates_across_instances():
+    # two supervisors born from one correlated failure must not back off
+    # in lockstep (per-instance RNG, no shared stream)
+    a = _jitter_sup(seed=1)
+    b = _jitter_sup(seed=2)
+    seq_a = [a._next_delay(f) for f in range(1, 8)]
+    seq_b = [b._next_delay(f) for f in range(1, 8)]
+    assert seq_a != seq_b
+    # and the draws actually spread (not stuck at either bound)
+    assert len({round(d, 6) for d in seq_a[1:]}) > 1
+
+
+@pytest.mark.fast
+def test_backoff_jitter_caps_at_backoff_max():
+    sup = _jitter_sup(backoff=5.0, backoff_max=8.0, seed=7)
+    delays = [sup._next_delay(f) for f in range(1, 10)]
+    assert all(5.0 <= d <= 8.0 for d in delays)
+    # degenerate config: base above cap clamps to the cap
+    tight = _jitter_sup(backoff=10.0, backoff_max=4.0)
+    assert tight._next_delay(1) == 4.0
+    assert tight._next_delay(2) <= 4.0

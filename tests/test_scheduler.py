@@ -1,0 +1,558 @@
+"""Tests for the gang scheduler (ISSUE 19; docs/RESILIENCE.md
+§Scheduler): starvation/fairness edges on a fake clock — a
+never-grantable gang is parked without head-of-line blocking, priority
+ties grant FIFO by admit time, an exiting gang is never a preemption
+target — the persisted scheduler-ledger protocol (conservation on every
+intact record, seq monotone across restarts, tolerant readers), the
+plane-level gang lifecycle, and the 3-run priority-inversion drill:
+a low-priority 2-seat gang and a high-priority 1-seat gang fill the
+pool, a third gang queues behind them, the autoscale rule admits a grow
+seat for the high-priority gang, and the scheduler resolves the
+starvation through an audited admit → preempt_to_grant → grant → grow
+chain — the victim shrinks through the cohort-surgery excise path and
+the excised seat's residual mass survives the fold (NumPy oracle,
+≤ 1e-6).
+
+The unit tests and the plane lifecycle are host-only and fast; the
+subprocess drill is ``slow``-marked (scripts/t1.sh runs a bounded
+fake-clock smoke instead).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from dgc_tpu.control import rules
+from dgc_tpu.control.plane import ControlPlane, RunSpec
+from dgc_tpu.control.rules import Rule
+from dgc_tpu.control.scheduler import (GangScheduler, SCHED_GRANTS,
+                                       SCHED_QUEUE, grant_latency_summary,
+                                       read_grant_ledger, read_queue)
+from dgc_tpu.control.supervisor import parse_env_file
+from dgc_tpu.resilience import surgery
+from dgc_tpu.telemetry import registry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(ROOT, "tests", "sched_worker.py")
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+        return self.t
+
+
+# --------------------------------------------------------------------- #
+# grant policy: priorities, FIFO ties, starvation edges                  #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_priority_then_fifo_by_admit_time():
+    clk = FakeClock()
+    s = GangScheduler(4, clock=clk)
+    s.admit("a", 1, priority=0)
+    clk.tick()
+    s.admit("b", 1, priority=0)     # same priority, later admit
+    clk.tick()
+    s.admit("c", 1, priority=5)     # higher priority, latest admit
+    granted = [d["name"] for d in s.tick()]
+    assert granted == ["c", "a", "b"]     # priority first, then FIFO
+    assert s.snapshot()["free"] == 1
+
+
+@pytest.mark.fast
+def test_same_instant_ties_break_by_admission_seq():
+    # a fake clock can admit two gangs at the same instant: the
+    # admission sequence keeps the order deterministic
+    s = GangScheduler(2, clock=FakeClock())
+    s.admit("x", 1, priority=1, now=100.0)
+    s.admit("y", 1, priority=1, now=100.0)
+    assert [d["name"] for d in s.tick()] == ["x", "y"]
+
+
+@pytest.mark.fast
+def test_never_grantable_gang_is_parked_not_blocking():
+    clk = FakeClock()
+    s = GangScheduler(3, clock=clk)
+    s.admit("whale", 5, priority=9)       # demand exceeds the whole pool
+    assert s.tick() == []
+    assert s.pending() == 0               # parked: no control loop spin
+    assert s.snapshot()["unschedulable"] == ["whale"]
+    # surfaced ONCE, then silent
+    s.tick(), s.tick()
+    # ... and smaller work behind it is never head-of-line blocked
+    s.admit("minnow", 1, priority=0)
+    granted = [d["name"] for d in s.tick()]
+    assert granted == ["minnow"]
+    snap = s.snapshot()
+    assert snap["free"] == 2 and snap["holdings"]["minnow"]["slots"] == 1
+
+
+@pytest.mark.fast
+def test_no_backfill_past_a_starved_schedulable_head():
+    clk = FakeClock()
+    s = GangScheduler(3, clock=clk)
+    s.admit("big", 2, priority=5)
+    clk.tick()
+    s.admit("small", 1, priority=0)
+    assert [d["name"] for d in s.tick()] == ["big", "small"]
+    # pool now full; an equal-priority 2-seat gang is starved with no
+    # STRICTLY-lower victim holding >= 2 seats ("small" has 1 — a shrink
+    # would leave no survivor for the elastic merge)
+    clk.tick()
+    s.admit("urgent", 2, priority=5)
+    assert s.tick() == []
+    # the lower-priority 1-seat entry behind the starved head must NOT
+    # jump it (that is exactly the starvation the scheduler exists to
+    # prevent)
+    clk.tick()
+    s.admit("sneak", 1, priority=0)
+    assert s.tick() == []
+    assert s.pending() == 2
+
+
+@pytest.mark.fast
+def test_duplicate_admit_rejected_and_cancel():
+    s = GangScheduler(2, clock=FakeClock())
+    rec = s.admit("g", 1)
+    assert rec["event"] == "admit" and rec["queue_depth"] == 1
+    assert s.admit("g", 1) == {"duplicate": True, "name": "g",
+                               "kind": "launch"}
+    # a different kind for the same name is NOT a duplicate
+    assert s.admit("g", 1, kind="grow")["event"] == "admit"
+    assert s.cancel("g", kind="grow") is True
+    assert s.cancel("g") is True
+    assert s.cancel("g") is False         # nothing left to drop
+    assert s.pending() == 0
+    with pytest.raises(ValueError):
+        s.admit("g", 1, kind="resize")
+    with pytest.raises(ValueError):
+        GangScheduler(0)
+
+
+# --------------------------------------------------------------------- #
+# preempt-to-grant: victim choice                                        #
+# --------------------------------------------------------------------- #
+
+def _pool_with(s, *gangs):
+    """Admit + grant (name, slots, priority) gangs into holdings."""
+    for name, slots, pri in gangs:
+        s.admit(name, slots, priority=pri)
+    granted = {d["name"] for d in s.tick()}
+    assert granted == {g[0] for g in gangs}
+    return s
+
+
+@pytest.mark.fast
+def test_preempt_picks_lowest_priority_active_victim():
+    clk = FakeClock()
+    s = _pool_with(GangScheduler(5, clock=clk),
+                   ("low", 2, 0), ("mid", 2, 1), ("hi", 1, 3))
+    clk.tick()
+    s.admit("urgent", 1, priority=9)
+    (d,) = s.tick()
+    assert d["decision"] == "preempt_to_grant"
+    assert d["victim"] == "low" and d["victim_priority"] == 0
+    assert d["name"] == "urgent" and d["short"] == 1
+    # in flight: a second tick must not stack another preemption
+    assert s.tick() == []
+    assert s.snapshot()["preempt_inflight"] == {"low": "urgent"}
+    # the shrink lands -> the freed seat grants the starved head
+    s.shrunk("low")
+    (g,) = s.tick()
+    assert g["decision"] == "grant" and g["name"] == "urgent"
+    assert s.snapshot()["preempt_inflight"] == {}
+    assert s.holding("low") == {"slots": 1, "priority": 0,
+                                "state": "active"}
+
+
+@pytest.mark.fast
+def test_preempt_skips_exiting_and_single_seat_gangs():
+    clk = FakeClock()
+    s = _pool_with(GangScheduler(4, clock=clk),
+                   ("low", 2, 0), ("mid", 2, 1))
+    s.mark_exiting("low")
+    clk.tick()
+    s.admit("urgent", 1, priority=9)
+    # "low" is winding down (its seats free on their own) -> the victim
+    # is the next-lowest ACTIVE gang
+    (d,) = s.tick()
+    assert d["victim"] == "mid"
+    # once mid is in flight too, nothing else qualifies
+    assert s.tick() == []
+
+
+@pytest.mark.fast
+def test_preempt_requires_strictly_lower_priority():
+    clk = FakeClock()
+    s = _pool_with(GangScheduler(2, clock=clk), ("peer", 2, 3))
+    clk.tick()
+    s.admit("rival", 1, priority=3)       # equal priority: no preemption
+    assert s.tick() == []
+    clk.tick()
+    s.admit("boss", 1, priority=4)
+    (d,) = s.tick()
+    assert d["victim"] == "peer" and d["name"] == "boss"
+
+
+# --------------------------------------------------------------------- #
+# the persisted ledger (the "scheduler-ledger" protocol)                 #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_ledger_conservation_and_seq_monotone(tmp_path):
+    root = str(tmp_path)
+    clk = FakeClock()
+    s = GangScheduler(4, root=root, clock=clk)
+    s.admit("a", 2, priority=1)
+    clk.tick()
+    s.admit("b", 1, priority=0)
+    clk.tick()
+    s.tick()
+    s.shrunk("a")
+    s.mark_exiting("a")
+    s.completed("b")
+    s.close()
+
+    records, skipped = read_grant_ledger(root)
+    assert skipped == 0
+    events = [r["event"] for r in records]
+    assert events == ["admit", "admit", "grant", "grant", "shrunk",
+                      "exiting", "completed"]
+    seqs = [r["seq"] for r in records]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # EVERY intact record carries the conservation check
+    for r in records:
+        assert r["held"] + r["free"] == r["total"] == 4, r
+
+    snap = read_queue(root)
+    assert snap is not None and snap["queue"] == []
+    assert snap["free"] == 3 and snap["holdings"]["a"]["slots"] == 1
+    assert snap["seq"] == seqs[-1]
+
+    lat = grant_latency_summary(records)
+    assert lat["n"] == 2 and lat["max_s"] >= lat["median_s"] >= 0.0
+
+
+@pytest.mark.fast
+def test_seq_resumes_across_scheduler_restart(tmp_path):
+    root = str(tmp_path)
+    s = GangScheduler(2, root=root, clock=FakeClock())
+    s.admit("a", 1)
+    s.tick()
+    s.close()
+    last = read_grant_ledger(root)[0][-1]["seq"]
+
+    s2 = GangScheduler(2, root=root, clock=FakeClock(200.0))
+    rec = s2.admit("b", 1)
+    s2.close()
+    # the new incarnation resumed PAST everything durable: the ledger's
+    # surviving prefix stays the true, strictly-monotone history
+    assert rec["seq"] == last + 1
+
+
+@pytest.mark.fast
+def test_readers_tolerate_torn_and_absent_files(tmp_path):
+    root = str(tmp_path)
+    assert read_queue(root) is None
+    assert read_grant_ledger(root) == ([], 0)
+    with open(os.path.join(root, SCHED_QUEUE), "w") as f:
+        f.write('{"total": 3, "que')                  # torn snapshot
+    assert read_queue(root) is None
+    with open(os.path.join(root, SCHED_QUEUE), "w") as f:
+        json.dump(["not", "a", "snapshot"], f)
+    assert read_queue(root) is None
+    with open(os.path.join(root, SCHED_GRANTS), "w") as f:
+        f.write('{"event": "admit", "seq": 1, "total": 3, "held": 0, '
+                '"free": 3}\n')
+        f.write('{"event": "grant", "se')             # torn tail
+    records, skipped = read_grant_ledger(root)
+    assert len(records) == 1 and skipped == 1
+    assert grant_latency_summary(records) is None     # no intact grant
+
+
+# --------------------------------------------------------------------- #
+# the monitor's SCHED lane                                               #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_monitor_sched_lane(tmp_path):
+    from dgc_tpu.telemetry import monitor
+    root = str(tmp_path)
+    assert monitor.collect_sched(root) is None      # no scheduler ran
+
+    clk = FakeClock()
+    s = GangScheduler(4, root=root, clock=clk)
+    s.admit("train", 3, priority=1)
+    s.admit("whale", 9, priority=0)
+    clk.tick(2.0)
+    s.tick()
+    s.admit("batch", 2, priority=0)
+    s.close()
+
+    lane = monitor.collect_sched(root)
+    assert lane["total"] == 4 and lane["free"] == 1
+    assert lane["queue_depth"] == 1                 # batch (whale parked)
+    assert lane["holdings"] == {"train": 3}
+    assert lane["unschedulable"] == ["whale"]
+    assert lane["grant_latency"]["n"] == 1
+    assert lane["ledger_skipped"] == 0
+
+    fsnap = monitor.collect_fleet(root)
+    assert fsnap["sched"]["holdings"] == {"train": 3}
+    status = monitor.render_fleet_status(fsnap)
+    assert "SCHED:" in status and "1/4 free" in status
+    assert "train:3" in status and "UNSCHEDULABLE [whale]" in status
+    om = monitor.render_openmetrics_fleet(fsnap)
+    assert "dgc_sched_slots_total 4" in om
+    assert "dgc_sched_slots_free 1" in om
+    assert "dgc_sched_queue_depth 1" in om
+    assert 'dgc_sched_held_slots{run="train"} 3' in om
+    assert "dgc_sched_grant_latency_seconds" in om
+
+
+# --------------------------------------------------------------------- #
+# plane-level gang lifecycle (fast: trivial member commands)             #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_plane_gang_grant_queue_and_complete(tmp_path):
+    root = str(tmp_path)
+
+    def gang(name, n, secs=0.4):
+        return [RunSpec(
+            f"{name}{i}",
+            [sys.executable, "-c", f"import time; time.sleep({secs})"],
+            run_dir=os.path.join(root, f"{name}{i}"), backoff=0.1)
+            for i in range(n)]
+
+    sched = GangScheduler(2, root=root)
+    plane = ControlPlane([], root, rules=(), interval=0.05,
+                         scheduler=sched)
+    with pytest.raises(ValueError):
+        plane.submit("empty", [])
+    plane.submit("alpha", gang("alpha", 2), priority=0)
+    plane.submit("beta", gang("beta", 1, secs=0.2), priority=1)
+    with pytest.raises(ValueError):
+        plane.submit("alpha", gang("dup", 1))
+    final = plane.run(max_ticks=400)
+
+    # beta (higher priority) granted first; alpha (2 seats) had to wait
+    # for beta's slot to free — and everything completed
+    for name in ("alpha0", "alpha1", "beta0"):
+        assert final[name]["rc"] == 0 and final[name]["state"] == "done"
+    chain = [(a["action"], a["run"]) for a in plane.actions]
+    assert chain[:2] == [("admit", "alpha"), ("admit", "beta")]
+    grants = [a for a in plane.actions if a["action"] == "grant"]
+    assert [g["run"] for g in grants] == ["beta", "alpha"]
+    assert set(grants[1]["result"]["launched"]) == {"alpha0", "alpha1"}
+    for a in plane.actions:
+        registry.validate_control_action(a)
+
+    # pool ledger saw every granted member; scheduler returned all seats
+    assert plane.pool.slots == {"alpha0": 1, "alpha1": 1, "beta0": 1}
+    snap = sched.snapshot()
+    assert snap["free"] == snap["total"] == 2 and snap["holdings"] == {}
+    records, skipped = read_grant_ledger(root)
+    assert skipped == 0
+    # a tick can land between alpha0's and alpha1's exits, in which case
+    # the partially-done gang is marked exiting (preemption shield)
+    # before it completes — tolerate that optional record
+    events = [r["event"] for r in records]
+    assert [e for e in events if e != "exiting"] == [
+        "admit", "admit", "grant", "completed", "grant", "completed"]
+    assert all(r["name"] == "alpha" for r in records
+               if r["event"] == "exiting")
+    for r in records:
+        assert r["held"] + r["free"] == r["total"] == 2
+
+
+@pytest.mark.fast
+def test_submit_without_scheduler_raises(tmp_path):
+    plane = ControlPlane([], str(tmp_path), rules=())
+    with pytest.raises(RuntimeError):
+        plane.submit("g", [RunSpec("g0", ["true"],
+                                   run_dir=str(tmp_path / "g0"))])
+
+
+# --------------------------------------------------------------------- #
+# the 3-run priority-inversion drill                                     #
+# --------------------------------------------------------------------- #
+
+def _drill_rules():
+    # the shipped autoscale detector, tuned tick-fast: two consecutive
+    # healthy ticks with headroom admit ONE grow seat
+    return (
+        Rule("autoscale-admit", rules.detect_autoscale, "admit",
+             min_hits=2, debounce_s=5.0, budget=1),
+    )
+
+
+def _member(root, gang, i, env_file, world, steps, priority=0):
+    run_dir = os.path.join(root, f"{gang}{i}")
+    return RunSpec(
+        f"{gang}{i}",
+        [sys.executable, WORKER, run_dir,
+         "--cohort", os.path.join(root, f"cohort_{gang}"),
+         "--steps", str(steps), "--step-ms", "25", "--world", str(world)],
+        run_dir=run_dir,
+        env_file=env_file,
+        env={"JAX_PROCESS_ID": str(i), "DGC_BOUNDARY_TIMEOUT": "3.5"},
+        backoff=0.1, priority=priority)
+
+
+@pytest.mark.slow
+def test_priority_inversion_drill(tmp_path):
+    root = str(tmp_path)
+    envs = {}
+    for gang, world in (("low", 2), ("hi", 1), ("bat", 1)):
+        envs[gang] = os.path.join(root, f"{gang}.env")
+        with open(envs[gang], "w") as f:
+            f.write(f"JAX_NUM_PROCESSES={world}\n")
+
+    sched = GangScheduler(3, root=root)
+    plane = ControlPlane([], root, rules=_drill_rules(), interval=0.25,
+                         scheduler=sched)
+    # step counts keep every phase overlapped: hi (120 steps, ~3 s) is
+    # still mid-run when the autoscale admit -> preempt -> grow chain
+    # lands (~1.5 s); low (100 steps) is still mid-run at the preempt
+    plane.submit("low", [_member(root, "low", i, envs["low"], 2, 100)
+                         for i in range(2)], priority=0)
+    plane.submit(
+        "hi", [_member(root, "hi", 0, envs["hi"], 2, 120)],
+        priority=2, slots_max=2,
+        grow_spec=lambda seat: _member(root, "hi", seat, envs["hi"], 2,
+                                       120))
+    plane.submit("bat", [_member(root, "bat", 0, envs["bat"], 1, 10)],
+                 priority=0)
+    final = plane.run(max_ticks=400)
+
+    # ---- outcomes: hi grew, low shrank (one seat excised), bat ran ----
+    for name in ("low0", "hi0", "hi1", "bat0"):
+        assert final[name]["rc"] == 0, (name, final[name])
+        assert final[name]["state"] == "done"
+    assert final["low1"]["rc"] == surgery.EXIT_SURGERY
+    assert final["low1"]["state"] == "quarantined"
+    assert final["low1"]["quarantined"] == "excised:manual"
+    assert parse_env_file(envs["low"]) == {"JAX_NUM_PROCESSES": "1"}
+    assert parse_env_file(envs["hi"]) == {"JAX_NUM_PROCESSES": "2"}
+
+    # ---- the audited chain: admit -> grant -> preempt -> grow --------
+    for a in plane.actions:
+        registry.validate_control_action(a)
+    chain = [(a["action"], a["run"]) for a in plane.actions]
+    assert chain[:3] == [("admit", "low"), ("admit", "hi"),
+                         ("admit", "bat")]
+    grants = [a for a in plane.actions if a["action"] == "grant"]
+    # priority order: hi first, then low (FIFO ahead of bat); bat only
+    # after low's surviving seat finished and freed the pool
+    assert [g["run"] for g in grants] == ["hi", "low", "bat"]
+
+    scale = [a for a in plane.actions
+             if a["action"] == "admit" and a["run"] == "hi0"]
+    assert scale and scale[0]["rule"] == "autoscale-admit"
+    assert scale[0]["evidence"]["kind"] == "autoscale"
+    assert scale[0]["evidence"]["target_slots"] == 2
+    assert scale[0]["result"]["admitted"] is True
+
+    (pre,) = [a for a in plane.actions
+              if a["action"] == "preempt_to_grant"]
+    assert pre["run"] == "low" and pre["rule"] == "scheduler-preempt"
+    assert pre["evidence"]["victim"] == "low"
+    assert pre["evidence"]["beneficiary"] == "hi"
+    assert pre["evidence"]["worker"] == 1 and pre["evidence"]["world"] == 2
+    assert pre["result"]["published"] == {"JAX_NUM_PROCESSES": "1"}
+    assert pre["result"]["order"]["verdict"] == "manual"
+    assert len(pre["result"]["order"]["paths"]) == 2   # EVERY member
+
+    (grow,) = [a for a in plane.actions if a["action"] == "grow"]
+    assert grow["run"] == "hi" and grow["rule"] == "scheduler-grow"
+    assert grow["evidence"]["seat"] == 1
+    assert grow["evidence"]["world"] == 2
+    assert grow["result"]["published"] == {"JAX_NUM_PROCESSES": "2"}
+    assert grow["result"]["launched"] == ["hi1"]
+    assert grow["result"]["cohort_restarted"] == ["hi0"]
+    # the preemption freed the seat BEFORE the grow granted it
+    order = [a["action"] for a in plane.actions]
+    assert order.index("preempt_to_grant") < order.index("grow")
+
+    # ---- the scheduler ledger tells the same story -------------------
+    records, skipped = read_grant_ledger(root)
+    assert skipped == 0
+    for r in records:
+        assert r["held"] + r["free"] == r["total"] == 3, r
+    events = [(r["event"], r["name"]) for r in records]
+    assert events.index(("preempt", "low")) \
+        < events.index(("shrunk", "low")) \
+        < [i for i, e in enumerate(events)
+           if e == ("grant", "hi")][1]                # the grow grant
+    shrunk = next(r for r in records if r["event"] == "shrunk")
+    assert shrunk["beneficiary"] == "hi"
+    grow_grant = [r for r in records if r["event"] == "grant"
+                  and r["kind"] == "grow"]
+    assert len(grow_grant) == 1 and grow_grant[0]["name"] == "hi"
+    completed = [r["name"] for r in records if r["event"] == "completed"]
+    assert set(completed) == {"low", "hi", "bat"}
+    snap = read_queue(root)
+    assert snap["free"] == 3 and snap["holdings"] == {}
+    assert grant_latency_summary(records)["n"] == 4
+
+    # ---- mass oracle: the excised seat's residual survived the fold --
+    for gang, seats in (("low", (0, 1)), ("hi", (0, 1)), ("bat", (0,))):
+        cohort = os.path.join(root, f"cohort_{gang}")
+        recs = []
+        for j in seats:
+            with open(os.path.join(cohort, f"res.{j}.json")) as f:
+                recs.append(json.load(f))
+        actual = float(np.sum(np.asarray([r["res"] for r in recs],
+                                         dtype=np.float64)))
+        oracle = float(np.sum(np.asarray([r["mass_in"] for r in recs],
+                                         dtype=np.float64)))
+        assert oracle > 0.0, gang
+        assert abs(actual - oracle) <= 1e-6, (gang, actual, oracle)
+    # low1's final residual was folded into the survivor and zeroed
+    with open(os.path.join(root, "cohort_low", "res.1.json")) as f:
+        orphan = json.load(f)
+    assert orphan["final"] is True and orphan["folded_into"] == 0
+    assert orphan["res"] == 0.0 and orphan["mass_in"] > 0.0
+    with open(os.path.join(root, "cohort_low", "res.0.json")) as f:
+        assert 1 in json.load(f)["folded"]
+
+    # ---- cohort walks: low 2 -> 1, hi 1 -> 2 -------------------------
+    evs = [json.loads(l) for l in open(
+        os.path.join(root, "low0", "supervise_events.jsonl"))]
+    worlds = [e["cohort"].get("JAX_NUM_PROCESSES") for e in evs
+              if e["event"] == "launch"]
+    assert worlds[0] == "2" and worlds[-1] == "1"
+    rec = surgery.read_exit_record(
+        os.path.join(root, "low1", "checkpoints", surgery.EXIT_RECORD))
+    assert rec["target"] == 1 and rec["world"] == 2
+    assert rec["verdict"] == "manual"
+    evs = [json.loads(l) for l in open(
+        os.path.join(root, "hi0", "supervise_events.jsonl"))]
+    worlds = [e["cohort"].get("JAX_NUM_PROCESSES") for e in evs
+              if e["event"] == "launch"]
+    assert worlds[0] == "1" and worlds[-1] == "2"
+
+    # every completed member finished its steps; progress is cohort-wide
+    with open(os.path.join(root, "cohort_low", "progress.json")) as f:
+        assert json.load(f)["step"] == 100
+    with open(os.path.join(root, "cohort_hi", "progress.json")) as f:
+        assert json.load(f)["step"] == 120
+
+    # the fleet stream carries the full audit trail + the freed-slot event
+    events = [json.loads(l) for l in open(
+        os.path.join(root, "control_events.jsonl"))]
+    freed = [e for e in events if e["event"] == "sched_slot_freed"]
+    assert freed and freed[0]["run"] == "low" and freed[0]["seat"] == "low1"
+    action_evs = [e for e in events if e["event"] == "control_action"]
+    assert len(action_evs) == len(plane.actions)

@@ -268,6 +268,25 @@ def test_regress_reads_bench_wrapper_format(tmp_path):
     assert regress.main([str(wrapper), str(bad), "--tol", "0.10"]) == 1
 
 
+def test_regress_gates_scheduler_service_metrics(tmp_path):
+    # the gang scheduler's service metrics (grant wait + schedulable
+    # backlog, both lower-is-better) ride the bench-object "scheduler"
+    # block and regress like any other run metric
+    base = tmp_path / "sched_base.json"
+    base.write_text(json.dumps(
+        {"scheduler": {"grant_latency_s": 0.5, "sched_queue_depth": 2}}))
+    ok = _write_summary_run(tmp_path / "ok.jsonl", grant_latency_s=0.52,
+                            sched_queue_depth=2.0)
+    assert regress.main([str(base), ok, "--tol", "0.10"]) == 0
+    slow = _write_summary_run(tmp_path / "slow.jsonl",
+                              grant_latency_s=0.9, sched_queue_depth=2.0)
+    assert regress.main([str(base), slow, "--tol", "0.10"]) == 1
+    backlog = _write_summary_run(tmp_path / "backlog.jsonl",
+                                 grant_latency_s=0.5,
+                                 sched_queue_depth=5.0)
+    assert regress.main([str(base), backlog, "--tol", "0.10"]) == 1
+
+
 def test_regress_usage_error_exit_two(tmp_path):
     empty = tmp_path / "garbage.txt"
     empty.write_text("not json at all\n")
