@@ -513,7 +513,9 @@ def main():
     #     plans drop the DGC overhead entirely -> ratio 1.0, never
     #     worse than the baseline.
     from dgc_tpu.compression.autotune import Autotuner, regime_histogram
-    from dgc_tpu.compression.planner import BUILTIN_FABRICS, plan_engine
+    from dgc_tpu.compression import gossip as gossip_lib
+    from dgc_tpu.compression.planner import (BUILTIN_FABRICS, GOSSIP_REGIMES,
+                                             REGIMES, plan_engine)
     planned = {}
     for fab_key, fab_name, gbps, workers in (
             ("32x25GbE", "32x25GbE", FABRIC_GBPS, FABRIC_WORKERS),
@@ -560,6 +562,57 @@ def main():
               f"{dense_ex / realized:.2f}x (model {pred['ratio']:.2f}x) | "
               f"replans {tuner.replan_count}",
               file=sys.stderr)
+
+        # decentralized gossip regimes (ISSUE 20): the same engine priced
+        # under each gossip family's amortized cadence. The per-bucket
+        # cost tables carry the modeled wire for the family whether or
+        # not it wins, and an open never-lose sweep (REGIMES + family)
+        # records whether the planner would actually ENGAGE gossip on
+        # this fabric — ici_v5e8 must keep the dense psum.
+        gblock = {}
+        for fam in GOSSIP_REGIMES:
+            topo = fam[len("gossip_"):]
+            gcfg = gossip_lib.make_config(topo, workers)
+            gplan = plan_engine(
+                dgc_setup.engine, fabric=BUILTIN_FABRICS[fab_name],
+                world=workers, candidates=REGIMES + (fam,))
+            fam_ms = sum(c[fam] for c in gplan.bucket_costs)
+            dense_tab_ms = sum(c["dense"] for c in gplan.bucket_costs)
+            engaged = gplan.gossip is not None
+            gblock[fam] = {
+                "sync_every": gcfg.sync_every,
+                "max_staleness": gcfg.max_staleness,
+                "neighbors_per_round": gossip_lib.neighbors_per_round(topo),
+                "modeled_gossip_ms": round(fam_ms, 5),
+                "modeled_dense_ms": round(dense_tab_ms, 5),
+                "engaged": engaged,
+                "regime_histogram": regime_histogram(gplan.regimes),
+                "predicted_ratio": round(gplan.predicted_ms()["ratio"], 3),
+            }
+            print(f"[planned {fab_key} {fam}] E={gcfg.sync_every} "
+                  f"bound={gcfg.max_staleness} | gossip {fam_ms:.4f} ms vs "
+                  f"dense {dense_tab_ms:.4f} ms | "
+                  f"{'ENGAGED' if engaged else 'all-gather kept'}",
+                  file=sys.stderr)
+        planned[fab_key]["gossip"] = gblock
+
+    # --- gossip staleness accounting for the regression gate
+    #     (telemetry/regress._from_bench_obj reads gossip.max_staleness_seen
+    #     and gossip.forced_syncs): the headline-fabric ring schedule run
+    #     through the NumPy round oracle for two full cadences with no
+    #     faults. Deterministic by construction — the worst age stays one
+    #     short of the cadence and no sync is ever forced, so a drifting
+    #     value flags a schedule-default or round-logic regression.
+    gring = gossip_lib.make_config("ring", FABRIC_WORKERS)
+    g_age = np.zeros((FABRIC_WORKERS,), np.int32)
+    g_forced, g_max_seen = 0, 0
+    for g_t in range(2 * gring.sync_every):
+        _, forced, g_age = gossip_lib.round_state_np(gring, g_t, g_age)
+        g_forced += int(forced)
+        g_max_seen = max(g_max_seen, int(g_age.max()))
+    print(f"[gossip oracle ring W={FABRIC_WORKERS}] max staleness seen "
+          f"{g_max_seen} (bound {gring.max_staleness}) | forced syncs "
+          f"{g_forced} over {2 * gring.sync_every} rounds", file=sys.stderr)
 
     # --- serving delta stream (ISSUE 17): modeled artifact bytes of one
     #     published top-k sparse param delta at the same DGC ratio (per-
@@ -628,6 +681,14 @@ def main():
             "dgc_ms": round(pk_dgc, 5),
             "ratio": round(pk_dense / pk_dgc, 3)},
         "planned": planned,
+        "gossip": {
+            "topology": "ring",
+            "world": FABRIC_WORKERS,
+            "sync_every": gring.sync_every,
+            "max_staleness": gring.max_staleness,
+            "max_staleness_seen": g_max_seen,
+            "forced_syncs": g_forced,
+        },
         "serving": {
             "ratio": 0.001,
             "wire_bytes_per_update": sdesc["wire_bytes_per_update"],

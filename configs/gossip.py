@@ -1,0 +1,39 @@
+"""Decentralized gossip exchange knob (docs/RESILIENCE.md §Gossip
+exchange): stack it and most sparse rounds exchange only with a rotating
+ring/hypercube neighborhood instead of the global all-gather — error
+feedback keeps undelivered mass in flight, and the in-graph staleness
+bound forces a full-sync round before any worker's view exceeds
+``max_staleness`` (graceful degradation, counted + fleet-visible):
+
+    python train.py --configs configs/cifar/resnet20.py configs/dgc/wm5.py \
+        configs/gossip.py
+
+Gossip is a plan-time OPT-IN (it changes the consistency model to
+bounded staleness, not just the wire layout): this config is the opt-in,
+and the planner still falls back to the synchronous exchange wherever
+all-gather is modeled cheaper — never-lose is untouched. Pulls in the
+fleet taps so the ``w_staleness`` lane and the forced-sync counter reach
+the sink (docs/TELEMETRY.md §Fleet monitoring).
+"""
+
+from dgc_tpu.utils.config import Config, configs
+
+# gossip staleness is fleet-visible: stack the fleet taps
+if "telemetry" not in configs.train:
+    configs.train.telemetry = Config()
+    configs.train.telemetry.enabled = True
+    configs.train.telemetry.every = 1
+    configs.train.telemetry.rotate_mb = 64
+configs.train.telemetry.fleet = True
+
+if "gossip" not in configs.train:
+    configs.train.gossip = Config()
+configs.train.gossip.enabled = True
+# "ring": rotating-stride segment, 2 neighbors/round, any world >= 2;
+# "hcube": XOR-mask matching, 1 partner/round, power-of-two worlds only
+configs.train.gossip.topology = "ring"
+# None -> the world-derived defaults (compression.gossip):
+#   sync_every   = max(2, W // 2)   scheduled full-sync cadence
+#   max_staleness = max(W, sync_every)   forced-sync bound (>= sync_every)
+configs.train.gossip.sync_every = None
+configs.train.gossip.max_staleness = None

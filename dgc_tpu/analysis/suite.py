@@ -45,6 +45,12 @@ tests exercise:
   the lowered HLO's collective counts — the all-dense plan compiles the
   sparse path away to zero gathers (the planner's never-lose fallback is
   structural, not a runtime branch).
+* **gossip is a plan-time opt-in with a static wire**: a build that
+  never names a gossip plan is byte-identical to the plain build with
+  zero compression/gossip code lowered; a gossip-planned build (ring or
+  hypercube) lowers to exactly ``Plan.collectives()`` — the round
+  classifier reweights what flows through the fixed value/index
+  gathers, it never changes the collective shape.
 * **cohort surgery is host-only**: importing resilience/surgery leaves
   the compiled step byte-identical to the plain build, and an ACTIVE
   coordinator with a published excise order adds ZERO collectives — the
@@ -394,6 +400,36 @@ def run_contract_suite(mesh=None, log: Callable[[str], None] = None,
         forbid_substrings=["compression/autotune"],
         identical_to=plain)
     run(atoff.name, atoff.check)
+
+    # gossip off: a build that never names a gossip plan IS the plain
+    # build, byte for byte, even with the schedule module imported — the
+    # decentralized exchange is a plan-time opt-in, never a runtime
+    # branch
+    import dgc_tpu.compression.gossip  # noqa: F401 — import must not leak
+    _, step_goff, _, _ = build_fixture(mesh, donate=False, telemetry=False)
+    goff = _step_contract(
+        "gossip-off-compiles-away", state, step_goff, inputs,
+        forbid_substrings=["compression/gossip"],
+        identical_to=plain)
+    run(goff.name, goff.check)
+
+    # gossip on: the decentralized exchange keeps the SAME static
+    # collective shape every round — the value + index all_gathers and
+    # the dense-tail psum lower once, and the round classifier (full
+    # sync vs neighborhood) only reweights what flows through them.
+    # Plan.collectives() must therefore equal the lowered HLO exactly
+    # as it does for every centralized regime family.
+    for topo in ("ring", "hcube"):
+        g_plan = plan_buckets([], fabric="32x25GbE", world=8,
+                              candidates=("gossip_" + topo,))
+        state_g, step_g, setup_g, _ = build_fixture(
+            mesh, donate=False, telemetry=False, plan=g_plan)
+        want = dict(setup_g.engine.plan.collectives(dense_reduces=1))
+        want["all-reduce"] += 1     # the step's loss mean
+        gon = _step_contract(
+            f"gossip-on-collective-count[{topo}]", state_g, step_g,
+            inputs, collectives=want, no_f64=True)
+        run(gon.name, gon.check)
 
     # control plane (ISSUE 12): supervision, rule evaluation, and
     # remediation are host-side Python over JSONL streams — importing

@@ -432,7 +432,7 @@ def _configs():
         ("adaptive", True, lambda: _adaptive_kwargs()),
     ]
     for reg in ("fp32", "int8", "int8_packed", "int4_packed",
-                "int8_delta_idx"):
+                "int8_delta_idx", "gossip_ring", "gossip_hcube"):
         cfgs.append((f"planned.{reg}", False,
                      lambda reg=reg: dict(donate=False, telemetry=False,
                                           plan=plan_for(reg))))

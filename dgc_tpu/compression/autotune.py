@@ -32,6 +32,15 @@ The refit fabric keeps ONE stable name (``autotuned-<base>``) from the
 first plan on, so ``Plan.key()`` — ``(fabric.name, world, regimes)`` —
 changes exactly when the chosen *regimes* change: a refit that lands on
 the same per-bucket decisions costs nothing.
+
+Gossip regimes (``planner.GOSSIP_REGIMES``) are deliberately NOT in the
+default candidate set the replans sweep: gossip changes the *consistency
+model* (bounded staleness, compression/gossip.py), not just the wire
+layout, so an operator opts in by constructing the engine with
+``candidates=REGIMES + GOSSIP_REGIMES`` — from then on the refits
+compare gossip's amortized per-neighborhood cost against all-gather on
+every fabric refit, and a fabric drift can move a bucket family between
+them (one rebuild, same as any regime flip).
 """
 
 import json
@@ -84,7 +93,9 @@ class Autotuner:
                  cost=DEFAULT_COST,
                  min_points: int = 2,
                  max_points: int = 4096,
-                 sink=None):
+                 sink=None,
+                 gossip_sync_every: Optional[int] = None,
+                 gossip_max_staleness: Optional[int] = None):
         base = resolve_fabric(fabric, runs_dir=runs_dir)
         name = (base.name if base.name.startswith("autotuned-")
                 else f"autotuned-{base.name}")
@@ -98,6 +109,11 @@ class Autotuner:
         self.max_points = int(max_points)
         self.fabric_out = fabric_out
         self.sink = sink
+        # gossip schedule knobs (only meaningful when a gossip family is
+        # in `candidates`): threaded into every replan so a fabric-driven
+        # regime flip keeps the operator's cadence
+        self.gossip_sync_every = gossip_sync_every
+        self.gossip_max_staleness = gossip_max_staleness
         #: measured (wire bytes, ms) pool, newest last
         self.points: List[Tuple[float, float]] = []
         self.refit_count = 0      # fits performed
@@ -115,9 +131,11 @@ class Autotuner:
         (possibly refit) fabric — the rebuild path: a warm-up ratio
         change reshapes the buckets, so the plan is always recomputed
         against the engine that will realize it."""
-        self._plan = plan_engine(engine, fabric=self.fabric,
-                                 world=self.world, cost=self.cost,
-                                 candidates=self.candidates)
+        self._plan = plan_engine(
+            engine, fabric=self.fabric, world=self.world, cost=self.cost,
+            candidates=self.candidates,
+            gossip_sync_every=self.gossip_sync_every,
+            gossip_max_staleness=self.gossip_max_staleness)
         return self._plan
 
     # -- measured inputs -------------------------------------------- #
@@ -192,7 +210,9 @@ class Autotuner:
         if self.fabric_out:
             self.write_fabric(self.fabric_out, epoch=epoch)
         new = plan_engine(engine, fabric=self.fabric, world=self.world,
-                          cost=self.cost, candidates=self.candidates)
+                          cost=self.cost, candidates=self.candidates,
+                          gossip_sync_every=self.gossip_sync_every,
+                          gossip_max_staleness=self.gossip_max_staleness)
         changed = self._plan is None or new.key() != self._plan.key()
         if self.sink is not None:
             self.sink.write_record({

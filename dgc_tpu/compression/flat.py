@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dgc_tpu.compression import gossip as _gossip_sched
 from dgc_tpu.compression.memory import DGCSGDMemory
 from dgc_tpu.ops import kernels
 from dgc_tpu.resilience import faults as _faults
@@ -75,6 +76,14 @@ _REGIMES = {
     "int8": ("i8", False), "int8_packed": ("i8", True),
     "int4_packed": ("i4", True),
     "int8_delta_idx": ("i8", "delta"),
+    # decentralized gossip exchange (compression/gossip.py): the WIRE is
+    # exactly the fp32 one (native values + plain offsets — the lanes,
+    # shapes and collective count never change with the round type); the
+    # schedule decides per round whether the gathered payload feeds the
+    # parameters (full-sync round) or only the rotating neighborhood's
+    # inbox (gossip round)
+    "gossip_ring": ("f32", False),
+    "gossip_hcube": ("f32", False),
 }
 
 
@@ -842,6 +851,43 @@ class FlatDGCEngine:
         #: selection paths
         self._mk_fwd_ids = tuple(
             bi for bi in self._sparse_ids if self._use_megakernel_fwd(bi))
+        # --- gossip exchange (compression/gossip.py) ----------------- #
+        # plan-static: self._gossip is the GossipConfig when the plan
+        # carries a gossip family, else None — and None lowers ZERO
+        # extra ops (contract: gossip-off-compiles-away). The Plan
+        # already rejects mixed gossip families / gossip next to other
+        # sparse regimes; what's validated here is what only the ENGINE
+        # knows.
+        self._gossip = getattr(self.plan, "gossip", None)
+        if self._gossip is not None:
+            if self._mem is None:
+                raise ValueError(
+                    "gossip regimes need momentum-correction memory "
+                    "(DGCSGDMemory): a worker's untransmitted mass must "
+                    "live in the error-feedback residual between "
+                    "neighborhood rounds")
+            if not self._sparse_ids:
+                raise ValueError(
+                    "gossip plan has no sparse buckets — with an all-"
+                    "dense plan (or compress_ratio >= 1) there is no "
+                    "neighborhood payload to exchange; plan without the "
+                    "gossip candidates instead")
+            if self._megakernel:
+                raise ValueError(
+                    "megakernel=True is not supported with gossip "
+                    "regimes: the fused forward emits its candidates "
+                    "before the neighborhood inbox is folded into the "
+                    "velocities, so they would be one round stale")
+            if getattr(self.c, "fused_apply", False):
+                raise ValueError(
+                    "fused_apply=True is not supported with gossip "
+                    "regimes: the fused scatter cannot split the "
+                    "gathered payload between parameters (full-sync "
+                    "round) and the neighborhood inbox (gossip round)")
+            # the seg-top2 fused compensate also emits selection
+            # candidates before the inbox fold — run the plain
+            # compensate + standalone selection under gossip instead
+            self._seg_fused = False
 
     def _legacy_regime(self) -> str:
         """The uniform wire regime the compressor flags describe — what
@@ -1064,10 +1110,30 @@ class FlatDGCEngine:
         # word-wide bit scatter has no such problem.) The record's shape
         # is ratio-independent, so checkpoints survive warm-up ratio
         # changes.
-        return {"momentums_c": zc, "velocities_c": zc,
-                "momentums_d": zd, "velocities_d": zd,
-                "sent_bits": jnp.zeros((kernels.num_sent_words(T) if T else 0,),
-                                       jnp.int32)}
+        out = {"momentums_c": zc, "velocities_c": zc,
+               "momentums_d": zd, "velocities_d": zd,
+               "sent_bits": jnp.zeros((kernels.num_sent_words(T) if T else 0,),
+                                      jnp.int32)}
+        if self._gossip is not None:
+            # gossip state rides the ordinary memory dict, so checkpoint
+            # save/resume of the round clock is bitwise for free and the
+            # step guard's atomic memory revert covers it too:
+            #   gossip_clock  — rounds completed (the schedule's time)
+            #   gossip_age    — [W] rounds since each worker's mass last
+            #                   reached the params (replicated-by-
+            #                   construction: computed from replicated
+            #                   inputs on every worker)
+            #   gossip_inbox  — neighbor payloads received this round,
+            #                   folded into the velocities NEXT round
+            #                   (after the deferred transmit mask — a
+            #                   freshly received value must not be wiped
+            #                   by the receiver's own transmit record)
+            #   gossip_forced — cumulative staleness-forced full syncs
+            out["gossip_clock"] = jnp.zeros((), jnp.int32)
+            out["gossip_age"] = jnp.zeros((self._gossip.world,), jnp.int32)
+            out["gossip_inbox"] = jnp.zeros((T,), sdt)
+            out["gossip_forced"] = jnp.zeros((), jnp.int32)
+        return out
 
     def _compensate_acc(self, mmt, vec, grad, sent_bits=None,
                         want_cands=False):
@@ -2192,6 +2258,41 @@ class FlatDGCEngine:
                         want_cands=self._seg_fused)
         else:
             comp = gc
+
+        # --- gossip round state (compression/gossip.py) --- plan-static:
+        # None lowers nothing. The round type, staleness ages and row
+        # weights are pure functions of replicated memory state, so every
+        # worker computes identical values — zero extra collectives.
+        g_cfg = self._gossip
+        if g_cfg is not None:
+            if int(world_size) != g_cfg.world:
+                raise ValueError(
+                    f"gossip plan was built for world={g_cfg.world} but "
+                    f"exchange runs with world_size={world_size} — "
+                    "replan for the current cohort")
+            if op != "average":
+                raise ValueError(
+                    "gossip regimes require op='average': the neighbor "
+                    f"mixing weights fold into the averaging divide "
+                    f"(got op={op!r})")
+            g_clock = mem["gossip_clock"]
+            g_forced0 = mem["gossip_forced"]
+            g_dropped = (_faults.gossip_dropped(g_cfg.world, g_clock)
+                         if _faults.armed() else None)
+            g_full, g_forced, g_new_age = _gossip_sched.round_state(
+                g_cfg, g_clock, mem["gossip_age"], g_dropped)
+            g_widx = jax.lax.axis_index(axis_name)
+            g_row_w = _gossip_sched.row_weights(g_cfg, g_clock, g_widx,
+                                                g_full, g_dropped)
+            # fold LAST round's received neighbor mass into the velocity
+            # accumulator — AFTER the deferred transmit mask above, so a
+            # freshly received value can never be wiped by this worker's
+            # own transmit record; and into the VELOCITY only (the
+            # sender already ran its momentum), matching the oracle in
+            # tests/test_gossip.py. The inbox is consumed exactly once:
+            # it is rewritten from this round's gather below.
+            vc = vc + mem["gossip_inbox"].astype(vc.dtype)
+            comp = vc
         if os.environ.get("DGC_VERIFY_MUTATE", "") == "cast_bf16":
             # seeded mutation (tests/test_analysis_verify.py): a silent
             # precision drop on the compensated gradient — the dgcver
@@ -2479,6 +2580,16 @@ class FlatDGCEngine:
         # the halves back out materializes a 0.66 ms loop fusion);
         # scatter-set into the live mmt/vec buffers (1.8 ms) and sub-word
         # masks (serial while-loop) stay avoided.
+        if g_cfg is not None:
+            # per-sender row weights realize the round semantics on the
+            # ONE gathered wire (shapes and collectives identical every
+            # round): full rounds weight each live sender 1 (the
+            # ordinary all-gather average after the /W below, a dropped
+            # sender zero-weighted so its mass stays in its residual);
+            # gossip rounds weight this worker's in-neighbors W/outdeg
+            # (-> 1/outdeg after the /W — mixing columns sum to 1, so
+            # global signed mass is conserved, oracle-pinned).
+            g_values = g_values * g_row_w[:, None].astype(g_values.dtype)
         wire = g_values.reshape(-1).astype(dt)
         mk_apply = self._use_megakernel_apply(m, int8_ef, dt)
         if op == "average" and not mk_apply:
@@ -2559,6 +2670,24 @@ class FlatDGCEngine:
                     else:
                         new_bits = kernels.pack_sent_bits(
                             indices, T, sentinel=self.layout.sentinel)
+        if g_cfg is not None:
+            with _trace.phase("apply"):
+                if g_dropped is not None:
+                    # a dropped worker's transmit record is voided: the
+                    # round carried none of its mass (receivers folded a
+                    # zero-weighted row), so the mass must stay in its
+                    # error-feedback residual for a later round — the
+                    # droplink leg of the conservation oracle
+                    new_bits = jnp.where(g_dropped[g_widx],
+                                         jnp.zeros_like(new_bits),
+                                         new_bits)
+                # split the scattered payload by round type: on a gossip
+                # round it feeds ONLY the neighborhood inbox (folded into
+                # the velocities next round) and the parameters see zeros
+                # from the sparse tier; on a full-sync round it feeds the
+                # parameters and the inbox resets
+                g_inbox = jnp.where(g_full, jnp.zeros_like(acc), acc)
+                acc = jnp.where(g_full, acc, jnp.zeros_like(acc))
 
         # --- dense fallback block: one collective + correction ---
         # dense-PLANNED buckets ride the SAME psum as the dense tail (one
@@ -2623,6 +2752,12 @@ class FlatDGCEngine:
                    "momentums_d": md, "velocities_d": mem["velocities_d"],
                    "sent_bits": kernels.vtag(new_bits,
                                              "dgcver.sink.sent_bits")}
+            if g_cfg is not None:
+                mem["gossip_clock"] = g_clock + 1
+                mem["gossip_age"] = g_new_age
+                mem["gossip_inbox"] = g_inbox.astype(vc.dtype)
+                mem["gossip_forced"] = (g_forced0
+                                        + g_forced.astype(jnp.int32))
         if telemetry:
             # transmitted energy from the live payload (invalid slots carry
             # 0.0): under deferred masking vc still holds the transmitted
@@ -2700,6 +2835,11 @@ class FlatDGCEngine:
             vc = vc * keep
             if m.momentum_masking:
                 mc = mc * keep
+        if "gossip_inbox" in mem:
+            # pending neighbor mass is velocity-in-flight (the next
+            # exchange folds it in after the mask — same order as here);
+            # materializing it keeps the canonical view mass-conserving
+            vc = vc + mem["gossip_inbox"].astype(vc.dtype)
         return {
             "momentums": jnp.concatenate([mc, mem["momentums_d"]]),
             "velocities": jnp.concatenate([vc, mem["velocities_d"]]),
@@ -2739,6 +2879,14 @@ class FlatDGCEngine:
         # loaded buffers are canonical (already masked): nothing pending
         out["sent_bits"] = jnp.zeros((kernels.num_sent_words(T) if T
                                       else 0,), jnp.int32)
+        # gossip clock/ages ride through from the caller's memory; the
+        # inbox stays empty — memory_full materialized any pending
+        # neighbor mass into the canonical velocities at save time
+        for k in ("gossip_clock", "gossip_age", "gossip_forced"):
+            if k in mem:
+                out[k] = mem[k]
+        if "gossip_inbox" in mem:
+            out["gossip_inbox"] = jnp.zeros_like(mem["gossip_inbox"])
         return out
 
 

@@ -1,0 +1,278 @@
+"""Gossip sparse exchange with in-graph bounded staleness (ISSUE 20).
+
+Every sparse step today ends in one global all-gather — a synchronous
+barrier that is both the scaling wall on the slow fabric and the last
+single point of synchronous failure. DGC's own error feedback (Lin et
+al., ICLR 2018 §3) is exactly what makes barrier-free exchange safe:
+gradient mass that has not propagated yet is never lost, only deferred
+in a velocity accumulator — the same insight behind decentralized
+parallel SGD (Lian et al.) and compressed gossip (Koloskova et al.,
+CHOCO-SGD). This module is the *schedule algebra* of that exchange; the
+flat engine (``compression/flat.py``) realizes it on the wire.
+
+Design (all compile-time static — shapes and collectives never change):
+
+* **Rotating neighborhoods.** Each gossip round, worker ``w`` exchanges
+  its sparse payload with a small neighborhood that is a pure function
+  of ``(round, world, topology)``:
+
+  - ``ring``:  partners ``{w - s, w + s} mod W`` with the stride
+    ``s = 1 + round mod (W // 2)`` rotating through every chord length,
+    so any worker's mass reaches any other in at most ``W//2`` rounds.
+    At ``2s == W`` (even worlds) the two partners coincide — that round
+    is a perfect matching of antipodes with out-degree 1.
+  - ``hcube``: the pairwise partner ``w XOR m`` with the mask
+    ``m = 1 + round mod (W - 1)`` (an involution, hence a perfect
+    matching every round; requires a power-of-two world).
+
+  In- and out-neighborhoods coincide for both topologies, and each
+  sender's payload is divided by its out-degree, so the mixing matrix's
+  columns sum to exactly 1: global signed mass is conserved every round
+  (oracle-pinned in tests/test_gossip.py).
+
+* **Gossip accumulation, not gossip apply.** The repo's replicated
+  parameter doctrine (training/step.py keeps params ``P()``-replicated;
+  the loss psum and checkpoints depend on it) forbids worker-dependent
+  parameter updates. Neighborhood structure therefore lives in the
+  per-worker *memory*: a gossip round scatters the received neighbor
+  payloads into a ``gossip_inbox`` buffer that the NEXT round folds
+  into the velocity accumulator (after the deferred transmit mask, so
+  freshly received mass can never be wiped by the receiver's own
+  record). Parameters move only on **full-sync rounds** — the ordinary
+  global all-gather apply — which happen on the static cadence
+  ``sync_every`` and whenever the staleness bound forces one.
+
+* **In-graph bounded staleness.** ``gossip_age[p]`` counts rounds since
+  worker ``p``'s contribution last reached the parameters. Every worker
+  computes the identical ``[W]`` vector from replicated inputs (zero
+  extra collectives). When any predicted age would exceed
+  ``max_staleness``, the engine forces a full-sync round — graceful
+  degradation back to all-gather, not an error. Ages are clamped at
+  ``max_staleness``, so the bound holds *by construction*; a
+  persistently unreachable peer (see the ``droplink`` fault) keeps the
+  breach asserted and the engine degrades to a full sync every round —
+  the maximal remediation, documented in docs/RESILIENCE.md §Gossip.
+
+Every schedule function ships a NumPy twin (``*_np``) so the
+mass-conservation oracle never shares code with the traced path.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "GossipConfig", "TOPOLOGIES", "make_config",
+    "default_sync_every", "default_max_staleness",
+    "ring_stride", "hcube_mask", "out_neighbors",
+    "recv_weights_np", "row_weights_np", "round_state_np",
+    "round_state", "row_weights", "neighbors_per_round",
+]
+
+#: supported topologies, in planner-regime order (gossip_ring /
+#: gossip_hcube)
+TOPOLOGIES = ("ring", "hcube")
+
+
+class GossipConfig(NamedTuple):
+    """Static gossip schedule knobs — part of ``Plan.key()``, so any
+    change recompiles exactly once, like every other plan move."""
+
+    #: "ring" (stride-rotating 2-neighborhood) or "hcube" (XOR-mask
+    #: pairwise matching; power-of-two worlds only)
+    topology: str
+    #: sparse exchange group size (== the engine's world_size)
+    world: int
+    #: scheduled full-sync cadence: round ``t`` is a global all-gather
+    #: apply when ``t % sync_every == 0`` (round 0 is always full — a
+    #: warm start)
+    sync_every: int
+    #: staleness bound (rounds): when any worker's predicted age would
+    #: exceed this, the engine forces a full-sync round
+    max_staleness: int
+
+
+def default_sync_every(world: int) -> int:
+    """Half the ring's diameter: every chord rotates through at least
+    once between scheduled syncs, and a world of 2 still alternates."""
+    return max(2, world // 2)
+
+
+def default_max_staleness(world: int) -> int:
+    """One full neighborhood rotation — never tighter than the
+    scheduled cadence (a bound below ``sync_every`` would force a sync
+    every round and gossip would never engage)."""
+    return max(world, default_sync_every(world))
+
+
+def make_config(topology: str, world: int,
+                sync_every: Optional[int] = None,
+                max_staleness: Optional[int] = None) -> GossipConfig:
+    """Build + validate a :class:`GossipConfig`."""
+    if topology not in TOPOLOGIES:
+        raise ValueError(f"unknown gossip topology {topology!r}; "
+                         f"expected one of {TOPOLOGIES}")
+    if world < 2:
+        raise ValueError(f"gossip needs world >= 2, got {world}")
+    if topology == "hcube" and (world & (world - 1)):
+        raise ValueError(
+            f"gossip_hcube needs a power-of-two world (XOR matching), "
+            f"got {world} — use gossip_ring on this cohort")
+    se = default_sync_every(world) if sync_every is None else int(sync_every)
+    ms = (default_max_staleness(world) if max_staleness is None
+          else int(max_staleness))
+    if se < 1:
+        raise ValueError(f"sync_every must be >= 1, got {se}")
+    if ms < se:
+        raise ValueError(
+            f"max_staleness ({ms}) below sync_every ({se}) would force a "
+            "full sync every round — raise the bound or tighten the "
+            "cadence")
+    return GossipConfig(topology, int(world), se, ms)
+
+
+def neighbors_per_round(topology: str) -> int:
+    """Out-neighbor count the planner charges alpha/bytes per (the
+    ring's one degenerate antipode round is charged at 2 — an upper
+    bound keeps the model conservative)."""
+    return 2 if topology == "ring" else 1
+
+
+# --------------------------------------------------------------------- #
+# schedules: pure functions of (round, world) — polymorphic arithmetic  #
+# (python ints, numpy, jnp all work; no branches on traced values)      #
+# --------------------------------------------------------------------- #
+
+def ring_stride(clock, world: int):
+    """Ring chord length for this round: rotates 1..W//2."""
+    return 1 + clock % (world // 2)
+
+
+def hcube_mask(clock, world: int):
+    """Hypercube XOR mask for this round: rotates 1..W-1 (an involution
+    for every value, hence a perfect matching)."""
+    return 1 + clock % (world - 1)
+
+
+def out_neighbors(cfg: GossipConfig, clock: int, w: int) -> Tuple[int, ...]:
+    """Host-side out-neighborhood of worker ``w`` at round ``clock``
+    (== the in-neighborhood: both topologies are symmetric)."""
+    if cfg.topology == "ring":
+        s = int(ring_stride(clock, cfg.world))
+        lo, hi = (w - s) % cfg.world, (w + s) % cfg.world
+        return (lo,) if lo == hi else (lo, hi)
+    return (w ^ int(hcube_mask(clock, cfg.world)),)
+
+
+def recv_weights_np(cfg: GossipConfig, clock: int,
+                    receiver: int) -> np.ndarray:
+    """NumPy twin of the engine's gossip receive weights: ``[W]`` f32,
+    ``1/outdeg(p)`` for each in-neighbor ``p`` of ``receiver``, else 0.
+    Column sums over receivers equal 1 exactly (mass conservation)."""
+    w = np.zeros((cfg.world,), np.float32)
+    for p in out_neighbors(cfg, clock, receiver):
+        w[p] = 1.0 / len(out_neighbors(cfg, clock, p))
+    return w
+
+
+def row_weights_np(cfg: GossipConfig, clock: int, receiver: int,
+                   full: bool,
+                   dropped: Optional[np.ndarray] = None) -> np.ndarray:
+    """NumPy twin of :func:`row_weights` (pre-division by W): the per-
+    sender weight applied to the gathered ``[W, payload]`` rows before
+    the engine's ``/ world`` averaging divide."""
+    if full:
+        w = np.ones((cfg.world,), np.float32)
+    else:
+        w = recv_weights_np(cfg, clock, receiver) * cfg.world
+    if dropped is not None:
+        w = w * (1.0 - np.asarray(dropped, np.float32))
+    return w
+
+
+def round_state_np(cfg: GossipConfig, clock: int, age: np.ndarray,
+                   dropped: Optional[np.ndarray] = None):
+    """NumPy twin of :func:`round_state` for the oracle."""
+    age = np.asarray(age, np.int64)
+    live = (np.ones((cfg.world,), bool) if dropped is None
+            else ~np.asarray(dropped, bool))
+    is_sched = (clock % cfg.sync_every) == 0
+    tent = age + 1
+    pred = np.where(is_sched & live, 0, tent)
+    breach = bool(np.any(pred > cfg.max_staleness))
+    full = is_sched or breach
+    forced = breach and not is_sched
+    new_age = np.where(full & live, 0,
+                       np.minimum(tent, cfg.max_staleness))
+    return full, forced, new_age.astype(np.int32)
+
+
+# --------------------------------------------------------------------- #
+# traced forms (jnp) — what the engine lowers into the step              #
+# --------------------------------------------------------------------- #
+
+def _recv_weights(cfg: GossipConfig, clock, widx):
+    """Traced ``[W]`` f32 receive weights for this worker: 1/outdeg for
+    each in-neighbor, 0 elsewhere. ``clock`` and ``widx`` are traced
+    int32 scalars; everything else is plan-static."""
+    import jax.numpy as jnp
+
+    ids = jnp.arange(cfg.world, dtype=jnp.int32)
+    if cfg.topology == "ring":  # dgclint: ok[tracer-branch] — topology is plan-static GossipConfig, not a tracer
+        s = ring_stride(clock.astype(jnp.int32), cfg.world)
+        lo = jnp.mod(widx - s, cfg.world)
+        hi = jnp.mod(widx + s, cfg.world)
+        mask = (ids == lo) | (ids == hi)
+        # the antipode round (2s == W) is a single-partner matching:
+        # dividing by out-degree keeps the mixing columns summing to 1
+        deg = jnp.where(2 * s == cfg.world, 1.0, 2.0).astype(jnp.float32)
+        return mask.astype(jnp.float32) / deg
+    partner = jnp.bitwise_xor(widx,
+                              hcube_mask(clock.astype(jnp.int32),
+                                         cfg.world))
+    return (ids == partner).astype(jnp.float32)
+
+
+def round_state(cfg: GossipConfig, clock, age, dropped=None):
+    """In-graph round classification: ``(full, forced, new_age)``.
+
+    ``full`` — traced bool: this round is a global all-gather apply
+    (scheduled by cadence, or forced by a predicted staleness breach).
+    ``forced`` — traced bool: the breach alone forced it (scheduled
+    syncs don't count as forced). ``new_age`` — the post-round ``[W]``
+    int32 age vector, clamped at ``max_staleness`` so the bound holds
+    by construction. A ``dropped`` peer never resets (its mass stayed
+    in its residual), so a persistent droplink keeps the breach — and
+    the full-sync degradation — asserted every round."""
+    import jax.numpy as jnp
+
+    live = (jnp.ones((cfg.world,), bool) if dropped is None
+            else jnp.logical_not(dropped))
+    is_sched = jnp.equal(jnp.mod(clock, cfg.sync_every), 0)
+    tent = age + 1
+    pred = jnp.where(is_sched & live, 0, tent)
+    breach = jnp.any(pred > cfg.max_staleness)
+    full = jnp.logical_or(is_sched, breach)
+    forced = jnp.logical_and(breach, jnp.logical_not(is_sched))
+    new_age = jnp.where(jnp.logical_and(full, live), 0,
+                        jnp.minimum(tent, cfg.max_staleness))
+    return full, forced, new_age.astype(jnp.int32)
+
+
+def row_weights(cfg: GossipConfig, clock, widx, full, dropped=None):
+    """Traced ``[W]`` f32 per-sender weights on the gathered payload
+    rows, PRE the engine's ``/ world`` averaging divide:
+
+    * full rounds: 1 per live sender (``-> 1/W`` after the divide — the
+      ordinary all-gather average, with a dropped sender zero-weighted
+      so its mass stays in its own residual);
+    * gossip rounds: ``W / outdeg`` for this worker's in-neighbors
+      (``-> 1/outdeg`` after the divide), 0 for everyone else.
+    """
+    import jax.numpy as jnp
+
+    ones = jnp.ones((cfg.world,), jnp.float32)
+    w = jnp.where(full, ones, _recv_weights(cfg, clock, widx) * cfg.world)
+    if dropped is not None:
+        w = w * (1.0 - dropped.astype(jnp.float32))
+    return w

@@ -35,7 +35,12 @@ __all__ = ["Memory", "DGCSGDMemory", "ELASTIC_ADDITIVE_PREFIXES"]
 #: summation conserves every coordinate's owed gradient. Keys outside
 #: this registry (other than the flat engine's ``sent_bits`` transmit
 #: record) make the resharder refuse rather than guess a reduction.
-ELASTIC_ADDITIVE_PREFIXES = ("momentums", "velocities")
+#: ``gossip_inbox`` is in-flight neighbor mass the gossip exchange has
+#: received but not yet folded into velocities (compression.gossip) —
+#: additive for exactly the same reason the residual is. The gossip
+#: clock/age/forced counters are NOT additive; resilience/elastic.py
+#: reshards them specially (merge takes the max, split inherits).
+ELASTIC_ADDITIVE_PREFIXES = ("momentums", "velocities", "gossip_inbox")
 
 
 class Memory:

@@ -57,8 +57,10 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dgc_tpu.compression import gossip as _gossip
+
 __all__ = ["Fabric", "CostModel", "BucketGeom", "Plan",
-           "BUILTIN_FABRICS", "DEFAULT_COST", "REGIMES",
+           "BUILTIN_FABRICS", "DEFAULT_COST", "REGIMES", "GOSSIP_REGIMES",
            "FABRIC_SCHEMA", "FABRIC_VERSION",
            "fit_link_model", "load_fabric", "resolve_fabric",
            "bucket_geometry", "packed_index_bits", "delta_index_bits",
@@ -72,11 +74,21 @@ __all__ = ["Fabric", "CostModel", "BucketGeom", "Plan",
 REGIMES = ("dense", "fp32", "int8", "int8_packed", "int4_packed",
            "int8_delta_idx")
 
+#: the decentralized regime family (docs/RESILIENCE.md §Gossip
+#: exchange): same fp32 wire format, but the sparse payload moves only
+#: to a rotating neighborhood most rounds, with a scheduled/forced
+#: full-sync cadence. OPT-IN — not in the default :data:`REGIMES`
+#: candidate set, so default plans (and the recorded ici/eth planned
+#: ratios the regress gate pins) are untouched; pass
+#: ``candidates=REGIMES + GOSSIP_REGIMES`` to let the planner weigh
+#: gossip against all-gather per fabric.
+GOSSIP_REGIMES = ("gossip_ring", "gossip_hcube")
+
 #: every wire format the engine can realize (REGIMES plus the legacy
 #: uniform formats derived from compressor flags) — Plan validates
 #: against this set
 _KNOWN_REGIMES = frozenset(
-    REGIMES + ("fp32_packed", "fp16", "fp16_packed"))
+    REGIMES + GOSSIP_REGIMES + ("fp32_packed", "fp16", "fp16_packed"))
 
 FABRIC_SCHEMA = "dgc-fabric"
 FABRIC_VERSION = 1
@@ -314,7 +326,9 @@ def bucket_ms_from_profile(profile: Optional[Dict],
 def _regime_costs(g: BucketGeom, fabric: Fabric, world: int,
                   cost: CostModel, bucket_ms: Optional[float],
                   value_itemsize: int, index_itemsize: int,
-                  megakernel: bool = False) -> Dict[str, float]:
+                  megakernel: bool = False,
+                  gossip_sync_every: Optional[int] = None
+                  ) -> Dict[str, float]:
     """Predicted exchange ms of one bucket under every candidate regime.
 
     ``megakernel=True`` prices the compute side with the fused
@@ -344,6 +358,22 @@ def _regime_costs(g: BucketGeom, fabric: Fabric, world: int,
     quant = cost.quant_ms_per_elem * g.payload * (1 + world)
     pack = cost.pack_ms_per_elem * g.payload * (1 + world)
     scales = 4 * g.rows
+
+    def gossip_amortized(topology):
+        # amortized per-round wire under the gossip cadence: (E-1)
+        # neighborhood rounds (alpha charged PER NEIGHBOR per lane, and
+        # only d neighbor-payloads cross the fabric) plus 1 scheduled
+        # full-sync round (the ordinary 2-lane all-gather), over
+        # E = sync_every rounds. The sparse compute side runs every
+        # round either way, so it stays outside the amortization.
+        E = (gossip_sync_every if gossip_sync_every is not None
+             else _gossip.default_sync_every(world))
+        d = _gossip.neighbors_per_round(topology)
+        pb = g.payload * (value_itemsize + index_itemsize)
+        neigh = 2 * d * a + d * pb / bw
+        full = wire(pb, 2)
+        return comp + ((E - 1) * neigh + full) / E
+
     return {
         # marginal alpha of joining the always-present dense psum is 0
         "dense": 2 * value_itemsize * g.numel * (world - 1) / world / bw,
@@ -363,6 +393,10 @@ def _regime_costs(g: BucketGeom, fabric: Fabric, world: int,
         # per-bucket payload sort rides the pack coefficient.
         "int8_delta_idx": comp + quant + 2 * pack + wire(
             g.payload * (1 + g.delta_bits / 8) + scales, 3),
+        # decentralized fp32 wire: most rounds only the rotating
+        # neighborhood is paid for (see gossip_amortized above)
+        "gossip_ring": gossip_amortized("ring"),
+        "gossip_hcube": gossip_amortized("hcube"),
     }
 
 
@@ -400,7 +434,9 @@ class Plan:
                  world: int, bucket_costs: Sequence[Dict[str, float]] = (),
                  cost: CostModel = DEFAULT_COST,
                  bucket_ms: Optional[Sequence[float]] = None,
-                 candidates: Sequence[str] = REGIMES):
+                 candidates: Sequence[str] = REGIMES,
+                 gossip_sync_every: Optional[int] = None,
+                 gossip_max_staleness: Optional[int] = None):
         for r in regimes:
             if r not in _KNOWN_REGIMES:
                 raise ValueError(f"unknown exchange regime {r!r} "
@@ -413,12 +449,41 @@ class Plan:
         self.bucket_ms = (tuple(bucket_ms)
                           if bucket_ms is not None else None)
         self.candidates = tuple(candidates)
+        self.gossip_sync_every = gossip_sync_every
+        self.gossip_max_staleness = gossip_max_staleness
+        # a gossip plan carries one schedule for the whole sparse tier:
+        # the round clock, staleness ages and full-sync decision are
+        # global (per-memory, not per-bucket), so mixed families — or
+        # gossip next to an always-synced sparse regime — would make
+        # the staleness semantics unsatisfiable. Dense buckets are fine
+        # (they ride the psum every round).
+        fams = sorted({r for r in self.regimes
+                       if r.startswith("gossip_")})
+        if len(fams) > 1:
+            raise ValueError(f"mixed gossip families in one plan: {fams}")
+        if fams:
+            other = sorted({r for r in self.regimes
+                            if r != "dense"
+                            and not r.startswith("gossip_")})
+            if other:
+                raise ValueError(
+                    f"gossip plan may not mix {fams[0]} with other "
+                    f"sparse regimes {other} (dense buckets are fine)")
+            self.gossip = _gossip.make_config(
+                fams[0][len("gossip_"):], self.world,
+                sync_every=gossip_sync_every,
+                max_staleness=gossip_max_staleness)
+        else:
+            self.gossip = None
 
     # -- identity ------------------------------------------------- #
 
     def key(self) -> Tuple:
         """Static identity of the compiled exchange this plan induces."""
-        return (self.fabric.name, self.world, self.regimes)
+        base = (self.fabric.name, self.world, self.regimes)
+        # gossip schedule knobs change the traced round logic — a new
+        # cadence or bound is a recompile, like any other plan move
+        return base + ((self.gossip,) if self.gossip is not None else ())
 
     def __eq__(self, other):
         return isinstance(other, Plan) and self.key() == other.key()
@@ -484,6 +549,10 @@ class Plan:
             "value_kinds": tuple(sorted(kinds)),
             "packed_words": any(_uses_words(r) for r in sp),
             "eager_foldback": bool(kinds & {"i8", "i4"}),
+            # gossip rides the fp32 wire, so DGCV04's C3 must find the
+            # deferred sent_bits fold-back on every gossip variant
+            "gossip": (self.gossip.topology
+                       if self.gossip is not None else None),
         }
 
     # -- prediction ----------------------------------------------- #
@@ -509,7 +578,9 @@ class Plan:
         return plan_buckets([bucket_geometry(b) for b in buckets],
                             fabric=self.fabric, world=self.world,
                             cost=self.cost, bucket_ms=self.bucket_ms,
-                            candidates=self.candidates)
+                            candidates=self.candidates,
+                            gossip_sync_every=self.gossip_sync_every,
+                            gossip_max_staleness=self.gossip_max_staleness)
 
 
 def plan_buckets(geoms: Sequence[BucketGeom], *, fabric,
@@ -519,33 +590,64 @@ def plan_buckets(geoms: Sequence[BucketGeom], *, fabric,
                  candidates: Sequence[str] = REGIMES,
                  value_itemsize: int = 4,
                  index_itemsize: int = 4,
-                 megakernel: bool = False) -> Plan:
+                 megakernel: bool = False,
+                 gossip_sync_every: Optional[int] = None,
+                 gossip_max_staleness: Optional[int] = None) -> Plan:
     """Choose the cheapest regime per bucket. Ties break toward the
     earlier candidate (``dense`` first — the never-lose direction).
     ``megakernel`` prices compute with the fused coefficients (see
-    :func:`_regime_costs`)."""
+    :func:`_regime_costs`).
+
+    Gossip candidates are weighed per bucket like any other regime, but
+    a valid gossip plan carries ONE schedule for the whole sparse tier
+    (see :class:`Plan`), so a mixed greedy pick is resolved by a
+    family post-pass: the all-gather assignment and each candidate
+    gossip family (buckets choosing between that family and ``dense``)
+    are totaled, and the cheapest consistent family wins — ties toward
+    all-gather, the never-lose direction."""
     fabric = resolve_fabric(fabric)
     world = int(world or fabric.workers)
     regimes, tables = [], []
+    plain = [r for r in candidates if not r.startswith("gossip_")]
+    goss = [r for r in candidates if r.startswith("gossip_")]
     for i, g in enumerate(geoms):
         bm = (float(bucket_ms[i])
               if bucket_ms is not None and i < len(bucket_ms) else None)
         costs = _regime_costs(g, fabric, world, cost, bm,
                               value_itemsize, index_itemsize,
-                              megakernel=megakernel)
+                              megakernel=megakernel,
+                              gossip_sync_every=gossip_sync_every)
         best = min(candidates, key=lambda r: (costs[r],
                                               candidates.index(r)))
         regimes.append(best)
         tables.append(costs)
+    if goss and any(r.startswith("gossip_") for r in regimes):
+        # family post-pass: total each consistent assignment
+        def family_pick(fam_candidates):
+            pick = [min(fam_candidates,
+                        key=lambda r: (c[r], fam_candidates.index(r)))
+                    for c in tables]
+            return pick, sum(c[r] for c, r in zip(tables, pick))
+        options = []
+        if plain:
+            options.append(family_pick(plain))
+        for fam in goss:
+            fam_cands = (["dense"] if "dense" in candidates else []) + [fam]
+            options.append(family_pick(fam_cands))
+        regimes = min(options, key=lambda o: o[1])[0]
     return Plan(regimes, fabric, world, tables, cost=cost,
-                bucket_ms=bucket_ms, candidates=candidates)
+                bucket_ms=bucket_ms, candidates=candidates,
+                gossip_sync_every=gossip_sync_every,
+                gossip_max_staleness=gossip_max_staleness)
 
 
 def plan_engine(engine, fabric=None, profile: Optional[Dict] = None,
                 world: Optional[int] = None,
                 cost: CostModel = DEFAULT_COST,
                 candidates: Sequence[str] = REGIMES,
-                megakernel: Optional[bool] = None) -> Plan:
+                megakernel: Optional[bool] = None,
+                gossip_sync_every: Optional[int] = None,
+                gossip_max_staleness: Optional[int] = None) -> Plan:
     """Plan over a built ``FlatDGCEngine``'s buckets. ``profile`` is an
     ``attrib.profile_json`` dict (or None for the coefficient model);
     ``fabric`` resolves through :func:`resolve_fabric`. ``megakernel``
@@ -562,4 +664,6 @@ def plan_engine(engine, fabric=None, profile: Optional[Dict] = None,
     return plan_buckets(geoms, fabric=fabric, world=world, cost=cost,
                         bucket_ms=bm, candidates=candidates,
                         value_itemsize=itemsize, index_itemsize=idx_size,
-                        megakernel=megakernel)
+                        megakernel=megakernel,
+                        gossip_sync_every=gossip_sync_every,
+                        gossip_max_staleness=gossip_max_staleness)

@@ -39,6 +39,15 @@ Two degradation tiers:
    ``deadline_factor x median`` contributes a near-empty payload
    (``partial_frac``) for that step; error feedback absorbs the skipped
    contribution, the same algebra the elastic merge/split pins.
+
+Composition with gossip (``compression.gossip``): the policy masks a
+worker's payload *before* the exchange, so under a gossip plan a
+degraded straggler's withheld mass is invisible only to its current
+neighborhood — the rotating schedule means different peers see the
+shrunken payload each round, and the staleness bound still forces a
+full-sync round on schedule. The two mechanisms stack without talking
+to each other because both settle their books through the same error-
+feedback residual.
 """
 
 from typing import NamedTuple

@@ -28,6 +28,15 @@ stranded. This module converts that state between world sizes with
 * **BN stats** are per-worker *running statistics*, not additive mass:
   merge is a mean-reduce; split copies the parent's stats to every child
   (zeros would be invalid statistics).
+* **Gossip round state** (``compression.gossip``) reshards by its own
+  rules instead of refusing: the in-flight ``gossip_inbox`` is additive
+  mass (generic path); the replicated clock / forced-sync counters merge
+  by max; and the ``[world]``-long staleness vector follows the worker
+  regrouping — a merged worker's view is as stale as its stalest parent
+  (max over the source group), a split child inherits its parent's age,
+  a collapse broadcasts the global max. The neighborhood schedule itself
+  is a pure function of ``(step, world, topology)``, so it re-seeds from
+  the resharded clock with no stored state.
 
 What is and is not bitwise: a split child inherits bitwise; a merge is
 exact up to float addition order (sums accumulate in float32 and round
@@ -114,7 +123,10 @@ def _check_memory_keys(memory: Any) -> None:
     for path, _ in jax.tree_util.tree_flatten_with_path(memory)[0]:
         name = _leaf_path(path)
         last = name.rsplit("/", 1)[-1]
-        if last == "sent_bits":
+        if last in ("sent_bits", "gossip_clock", "gossip_age",
+                    "gossip_forced"):
+            # transmit record (cleared by the fold) / gossip round state
+            # (resharded specially below: merge = max, split = inherit)
             continue
         if any(part.startswith(ELASTIC_ADDITIVE_PREFIXES)
                for part in name.split("/")):
@@ -248,6 +260,25 @@ def reshard_state(host_state: Any, from_topo: Dict[str, int],
     mem_w = [_worker(host_state.memory, w) for w in range(fw)]
     bn_w = [_worker(host_state.batch_stats, w) for w in range(fw)]
 
+    # gossip round state (compression.gossip) reshards by its own rules,
+    # not by mass addition: the clock and forced-sync counter are
+    # replicated monotone counters (merge/collapse takes the max), and
+    # the [world]-long age vector follows the worker regrouping — a
+    # merged worker's view is as stale as its stalest parent, a split
+    # child starts with its parent's age. The in-flight gossip_inbox IS
+    # additive mass and rides the generic fold/sum path below.
+    _GOSSIP_KEYS = ("gossip_clock", "gossip_age", "gossip_forced")
+    has_gossip = isinstance(mem_w[0], dict) and "gossip_age" in mem_w[0]
+    if has_gossip:
+        g_clock = max(int(np.asarray(m["gossip_clock"])) for m in mem_w)
+        g_forced = max(int(np.asarray(m["gossip_forced"])) for m in mem_w)
+        g_age = np.max(np.stack([np.asarray(m["gossip_age"])
+                                 for m in mem_w]), axis=0)
+        log(f"[elastic] resharding gossip round state across {fw} -> {tw} "
+            f"workers (clock {g_clock}, max age {int(g_age.max())})")
+        mem_w = [{k: v for k, v in m.items() if k not in _GOSSIP_KEYS}
+                 for m in mem_w]
+
     if fw % tw == 0:
         k = fw // tw
         log(f"[elastic] merging {fw} workers -> {tw} "
@@ -279,6 +310,22 @@ def reshard_state(host_state: Any, from_topo: Dict[str, int],
                    for c in range(tw)]
         gmean = _mean_workers(bn_w)
         new_bn = [gmean for _ in range(tw)]
+
+    if has_gossip:
+        if fw % tw == 0:
+            k = fw // tw
+            new_age = np.stack([g_age[c * k:(c + 1) * k].max()
+                                for c in range(tw)]).astype(g_age.dtype)
+        elif tw % fw == 0:
+            k = tw // fw
+            new_age = g_age[np.arange(tw) // k].astype(g_age.dtype)
+        else:
+            new_age = np.full((tw,), g_age.max(), g_age.dtype)
+        new_mem = [dict(m) for m in new_mem]
+        for m in new_mem:
+            m["gossip_clock"] = np.asarray(g_clock, np.int32)
+            m["gossip_age"] = new_age
+            m["gossip_forced"] = np.asarray(g_forced, np.int32)
 
     return host_state.replace(memory=_stack_workers(new_mem),
                               batch_stats=_stack_workers(new_bn))

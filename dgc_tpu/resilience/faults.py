@@ -41,6 +41,19 @@ Comma-separated tokens, each ``kind[@step][:key=val]*``:
   code crash, bypassing every handler and atexit hook (the messy death a
   SIGTERM drill is too polite to model). Windowed like ``slow`` (fires
   at the first step inside the window).
+* ``droplink:peer=P[@K-L]`` — in-graph: deterministically suppress
+  worker P's contribution to the gossip exchange (docs/RESILIENCE.md
+  §Gossip exchange) for gossip rounds K..L inclusive (``@K`` = from K
+  onward; no window = every round). The window counts GOSSIP-CLOCK
+  rounds, not train steps — the round clock is what the schedule and
+  staleness ages run on. Every worker arms the same token (the traced
+  program must stay identical cohort-wide): receivers fold zero from P,
+  a full-sync round zero-weights P's row, and P's own transmit record
+  is voided so the dropped mass stays in P's error-feedback residual —
+  the mass-conservation oracle holds THROUGH the fault. P's staleness
+  age never resets while dropped, so a window longer than
+  ``max_staleness`` forces the degradation ladder's full-sync rung
+  every round.
 
 With ``DGC_FAULTS`` unset every hook is an identity at trace time: zero
 ops, zero HLO difference (the guards-off compile-away contract runs with
@@ -53,8 +66,8 @@ import signal
 from typing import Dict, NamedTuple, Optional
 
 __all__ = ["FaultPlan", "plan", "armed", "inject_nan_grads", "corrupt_wire",
-           "corrupt_indices", "maybe_kill", "maybe_slow", "maybe_hang",
-           "maybe_exit", "should_fail_init"]
+           "corrupt_indices", "gossip_dropped", "maybe_kill", "maybe_slow",
+           "maybe_hang", "maybe_exit", "should_fail_init"]
 
 ENV = "DGC_FAULTS"
 
@@ -76,6 +89,10 @@ class FaultPlan(NamedTuple):
     exit_code: Optional[int] = None
     #: inclusive (first, last) step window for ``exit``
     exit_window: Optional[tuple] = None
+    #: worker whose gossip contribution is suppressed; None = unarmed
+    droplink_peer: Optional[int] = None
+    #: inclusive (first, last) GOSSIP-ROUND window for ``droplink``
+    droplink_window: Optional[tuple] = None
 
 
 def plan(spec: Optional[str] = None) -> FaultPlan:
@@ -84,6 +101,7 @@ def plan(spec: Optional[str] = None) -> FaultPlan:
         spec = os.environ.get(ENV, "")
     nan_step = kill_step = slow_ms = slow_window = None
     hang_window = hang_secs = exit_code = exit_window = None
+    droplink_peer = droplink_window = None
     init_failures = 0
     bitflip = badidx = None
 
@@ -124,11 +142,17 @@ def plan(spec: Optional[str] = None) -> FaultPlan:
         elif head == "exit":
             exit_code = params.get("code", 1)
             exit_window = window(at) if at else (0, None)
+        elif head == "droplink":
+            if "peer" not in params:
+                raise ValueError(
+                    f"droplink needs :peer=P (got {tok!r} in {ENV})")
+            droplink_peer = params["peer"]
+            droplink_window = window(at) if at else (0, None)
         else:
             raise ValueError(f"unknown fault token {tok!r} in {ENV}")
     return FaultPlan(nan_step, kill_step, init_failures, bitflip, badidx,
                      slow_ms, slow_window, hang_window, hang_secs,
-                     exit_code, exit_window)
+                     exit_code, exit_window, droplink_peer, droplink_window)
 
 
 def armed() -> bool:
@@ -191,6 +215,26 @@ def corrupt_indices(g_indices):
     e = p.badidx["elem"] % flat.shape[0]
     return flat.at[e].set(jnp.asarray(p.badidx["set"], flat.dtype)
                           ).reshape(g_indices.shape)
+
+
+def gossip_dropped(world: int, clock):
+    """Traced ``[world]`` bool of workers whose gossip contribution is
+    suppressed at gossip round ``clock`` (a traced int32 scalar), or
+    ``None`` when no ``droplink`` token is armed — the Python-static
+    identity, so an unarmed build lowers ZERO extra ops (the gossip
+    compile-away contract depends on it). The window test is traced
+    (``(clock >= lo) & (clock <= hi)``): one compiled program covers
+    in-window and out-of-window rounds."""
+    p = plan()
+    if p.droplink_peer is None:
+        return None
+    import jax.numpy as jnp
+    lo, hi = p.droplink_window
+    inside = clock >= lo
+    if hi is not None:
+        inside = jnp.logical_and(inside, clock <= hi)
+    ids = jnp.arange(world, dtype=jnp.int32)
+    return jnp.logical_and(ids == (p.droplink_peer % world), inside)
 
 
 # ------------------------------------------------------------------ #

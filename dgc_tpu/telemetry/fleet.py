@@ -62,10 +62,12 @@ __all__ = [
 #: fleet lanes appended to the packed telemetry vector, in order; the
 #: first four are the dispersion lanes the worker_skew rollup reads —
 #: w_eff_ratio (the adaptive policy's effective send fraction,
-#: resilience/adaptive.py) is excluded from the skew: an ENGAGED policy
-#: is doing its job, not desyncing the cohort
+#: resilience/adaptive.py) and w_staleness (rounds since the worker's
+#: gossip mass last reached the params, compression/gossip.py) are
+#: excluded from the skew: an engaged policy / a rotating gossip age is
+#: the mechanism doing its job, not the cohort desyncing
 _FLEET_LANES = ("w_clock", "w_grad_norm", "w_residual_mass", "w_sent_ratio",
-                "w_eff_ratio")
+                "w_eff_ratio", "w_staleness")
 _SKEW_LANES = ("w_clock", "w_grad_norm", "w_residual_mass", "w_sent_ratio")
 
 #: relative-dispersion floor: cohort spreads below this never alert
@@ -77,7 +79,8 @@ _EPS = 1e-12
 # --------------------------------------------------------------------- #
 
 def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
-                 total_elems: int, eff_ratio=None) -> Tuple[Dict, Dict]:
+                 total_elems: int, eff_ratio=None, staleness=None,
+                 forced=None) -> Tuple[Dict, Dict]:
     """One packed all_gather -> ``(telemetry_means, fleet_stats)``.
 
     ``stats`` — the per-worker STEP_METRICS pytree (taps.assemble_step_
@@ -88,7 +91,12 @@ def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
     effective send fraction (a traced f32 scalar,
     resilience/adaptive.py); None (adaptive off) stamps a constant 1.0
     lane, so the packed vector's shape — and the program's collective
-    count — never depends on the mode.
+    count — never depends on the mode. ``staleness`` — this worker's
+    gossip age in rounds (traced i32/f32 scalar,
+    compression/gossip.py); ``forced`` — the cumulative
+    forced-full-sync counter (traced scalar, replicated across the
+    cohort). Both None when gossip is off: the lane/scalar stamp
+    constant 0.0 so shapes and collectives stay mode-independent.
 
     Replaces ``taps.pmean_stats``: the telemetry means are computed
     locally from the gathered matrix (identical on every worker, so the
@@ -110,11 +118,14 @@ def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
                   / jnp.float32(denom))
     eff = (jnp.ones((), jnp.float32) if eff_ratio is None
            else jnp.asarray(eff_ratio, jnp.float32).reshape(()))
+    stale = (jnp.zeros((), jnp.float32) if staleness is None
+             else jnp.asarray(staleness, jnp.float32).reshape(()))
     fvec = jnp.stack([local_clock,
                       stats["grad_norm"].astype(jnp.float32),
                       stats["residual_mass"].astype(jnp.float32),
                       sent_ratio,
-                      eff])
+                      eff,
+                      stale])
 
     packed = jnp.concatenate(
         [l.reshape(-1).astype(jnp.float32) for l in leaves] + [fvec])
@@ -123,7 +134,7 @@ def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
     # nidx*local_size+lidx worker numbering
     mat = jax.lax.all_gather(packed, axes if len(axes) > 1 else axes[0],
                              axis=0, tiled=False)
-    mat = mat.reshape((-1, packed.shape[0]))        # [W, total + 5]
+    mat = mat.reshape((-1, packed.shape[0]))        # [W, total + 6]
 
     mean = jnp.mean(mat[:, :total], axis=0)
     out, off = [], 0
@@ -149,6 +160,13 @@ def gather_stats(stats: Dict, axes: Sequence[str], *, clock,
     # constant 1.0, so this reads 0.0 there)
     fleet["adaptive_engaged"] = (
         jnp.min(cols["w_eff_ratio"]) < 0.999).astype(jnp.float32)
+    # gossip rollups: the stalest view anywhere in the cohort, and the
+    # cumulative forced-full-sync count (replicated in memory, so the
+    # local scalar is already the cohort's — no extra collective)
+    fleet["max_staleness_seen"] = jnp.max(cols["w_staleness"])
+    fleet["gossip_forced_syncs"] = (
+        jnp.zeros((), jnp.float32) if forced is None
+        else jnp.asarray(forced, jnp.float32).reshape(()))
     registry.validate_fleet_stats(fleet)
     return telem, {k: jnp.asarray(v, jnp.float32) for k, v in fleet.items()}
 

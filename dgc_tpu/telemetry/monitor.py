@@ -78,6 +78,9 @@ _WORKER_GAUGES = {
     "w_eff_ratio": ("dgc_worker_eff_ratio",
                     "per-worker effective send fraction from the "
                     "straggler-adaptive policy (1.0 = undegraded)"),
+    "w_staleness": ("dgc_worker_staleness",
+                    "per-worker gossip age in exchange rounds (0 = "
+                    "fresh / gossip off)"),
 }
 
 #: OpenMetrics names for scalar record columns (latest step's value)
@@ -95,6 +98,12 @@ _SCALAR_GAUGES = {
     "adaptive_engaged": ("dgc_adaptive_engaged",
                          "1 when the straggler-adaptive policy degraded "
                          "at least one worker this step"),
+    "max_staleness_seen": ("dgc_gossip_max_staleness",
+                           "stalest gossip age across the cohort this "
+                           "step (rounds)"),
+    "gossip_forced_syncs": ("dgc_gossip_forced_syncs",
+                            "cumulative staleness-breach-forced "
+                            "full-sync rounds"),
     "skipped_steps": ("dgc_guard_skipped_steps",
                       "cumulative guard-skipped updates"),
     "nonfinite_rate": ("dgc_guard_nonfinite_rate",
@@ -527,6 +536,20 @@ def render_status(snap: Dict) -> str:
                 if isinstance(v, (int, float)) and v < 0.999)
         lines.append("   ADAPTIVE: straggler send fraction degraded"
                      + degraded)
+
+    stale_seen = last.get("max_staleness_seen")
+    if isinstance(stale_seen, (int, float)) and stale_seen > 0:
+        parts = [f"max staleness {stale_seen:.0f} rounds"]
+        col = last.get("w_staleness")
+        if isinstance(col, list) and col:
+            vals = [float(v) if isinstance(v, (int, float)) else 0.0
+                    for v in col]
+            stalest = max(range(len(vals)), key=vals.__getitem__)
+            parts.append(f"stalest w{stalest} ({vals[stalest]:.0f})")
+        forced = last.get("gossip_forced_syncs")
+        if isinstance(forced, (int, float)) and forced > 0:
+            parts.append(f"FORCED SYNCS {forced:.0f}")
+        lines.append("   GOSSIP: " + "  ".join(parts))
 
     n_alerts = summary.get("desync_alerts", 0)
     if n_alerts:

@@ -113,6 +113,11 @@ FLEET_METRICS: Tuple[MetricSpec, ...] = (
                "per-worker effective send fraction from the straggler-"
                "adaptive policy (resilience.adaptive) — 1.0 when the "
                "policy is off or disengaged, < 1 for a degraded worker"),
+    MetricSpec("w_staleness", "per_worker",
+               "per-worker gossip age in exchange rounds: how long since "
+               "that worker's sparse mass last reached the replicated "
+               "params (compression.gossip) — 0 when gossip is off or "
+               "after every full-sync round"),
     MetricSpec("straggler", "scalar",
                "argmax worker index of w_clock this step (the worker the "
                "cohort waited on)"),
@@ -126,6 +131,15 @@ FLEET_METRICS: Tuple[MetricSpec, ...] = (
                "1.0 when the straggler-adaptive policy degraded at least "
                "one worker's send fraction this step (min w_eff_ratio < "
                "1), else 0.0", better="lower"),
+    MetricSpec("max_staleness_seen", "scalar",
+               "max of w_staleness across the cohort this step: the "
+               "stalest any worker's view got; bounded by the plan's "
+               "gossip max_staleness by construction", better="lower"),
+    MetricSpec("gossip_forced_syncs", "scalar",
+               "cumulative staleness-breach-forced full-sync rounds "
+               "(scheduled syncs excluded) — a rising count means the "
+               "gossip schedule is being overridden, e.g. by a dropped "
+               "link", better="lower"),
 )
 
 #: remediations the control plane (dgc_tpu.control, ISSUE 12) may take on a
@@ -286,6 +300,14 @@ RUN_METRICS: Tuple[MetricSpec, ...] = (
     MetricSpec("sched_queue_depth", "scalar",
                "gang-scheduler queue depth at collection time (pending "
                "admissions not yet granted)", better="lower"),
+    MetricSpec("max_staleness_seen", "scalar",
+               "max gossip staleness any worker's view reached over the "
+               "run (bench.py gossip.max_staleness_seen) — must stay "
+               "within the plan's max_staleness bound", better="lower"),
+    MetricSpec("gossip_forced_syncs", "scalar",
+               "staleness-breach-forced full-sync rounds over the run "
+               "(bench.py gossip.forced_syncs) — scheduled syncs "
+               "excluded", better="lower"),
 )
 
 

@@ -93,6 +93,14 @@ def _from_bench_obj(obj: Dict) -> Dict[str, float]:
             out["grant_latency_s"] = float(sch["grant_latency_s"])
         if isinstance(sch.get("sched_queue_depth"), (int, float)):
             out["sched_queue_depth"] = float(sch["sched_queue_depth"])
+    # gossip staleness accounting (lower is better; see registry) — as
+    # written by the t1.sh GOSSIP smoke or a gossip-planned bench run
+    gsp = obj.get("gossip")
+    if isinstance(gsp, dict):
+        if isinstance(gsp.get("max_staleness_seen"), (int, float)):
+            out["max_staleness_seen"] = float(gsp["max_staleness_seen"])
+        if isinstance(gsp.get("forced_syncs"), (int, float)):
+            out["gossip_forced_syncs"] = float(gsp["forced_syncs"])
     return out
 
 

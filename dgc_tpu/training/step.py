@@ -423,9 +423,16 @@ def build_train_step(apply_fn: Callable, dist_opt: DistributedOptimizer,
             # (a gather strictly dominates a mean), so the fleet build
             # costs at most one packed collective over the plain step
             from dgc_tpu.telemetry import fleet as _fleet
+            if isinstance(memory, dict) and "gossip_age" in memory:  # dgclint: ok[tracer-branch] — pytree-key membership is trace-static, not a tracer test
+                # gossip on: the age vector is replicated by construction,
+                # so indexing this worker's entry costs zero collectives
+                g_stale = memory["gossip_age"][widx]
+                g_forced = memory["gossip_forced"]
+            else:
+                g_stale = g_forced = None
             metrics["telemetry"], metrics["fleet"] = _fleet.gather_stats(
                 tstats, axes, clock=clock, total_elems=layout.total,
-                eff_ratio=frac)
+                eff_ratio=frac, staleness=g_stale, forced=g_forced)
         elif telemetry:
             # per-worker stats -> replicated (mesh mean), matching the
             # loss: the collective rides the same program (no dispatch)

@@ -100,6 +100,19 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_adaptive.py 
   "tests/test_multiprocess.py::test_fleet_two_process_adaptive" \
   -q -p no:cacheprovider -p no:xdist -p no:randomly \
   && echo "ADAPTIVE_SMOKE=ok" || { echo "ADAPTIVE_SMOKE=FAIL"; rc=1; }
+# gossip smoke (docs/RESILIENCE.md §Gossip exchange): the schedule
+# algebra, the engine-level gossip exchange vs the NumPy
+# mass-conservation oracle (ring + hypercube, droplink included), the
+# step-exact staleness-breach -> forced-sync drill, the fleet
+# w_staleness lane, the elastic gossip-state reshard — plus the REAL
+# 2-process ring run: a droplink on worker 3 must climb the staleness
+# ladder into forced full-syncs, the staleness gauges and forced-sync
+# counter must reach the fleet sink, and a mid-drill collective
+# checkpoint must round-trip the gossip clock state bitwise
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_gossip.py \
+  "tests/test_multiprocess.py::test_gossip_two_process_save_resume" \
+  -q -p no:cacheprovider -p no:xdist -p no:randomly \
+  && echo "GOSSIP_SMOKE=ok" || { echo "GOSSIP_SMOKE=FAIL"; rc=1; }
 # serving smoke (docs/SERVING.md): DeltaSpec wire path (meta/key pinning,
 # encode/decode/apply parity, error-feedback carryover), the exporter/
 # replica file protocol with gap -> resync -> rebase, the fleet serving

@@ -238,9 +238,11 @@ def test_plan_verify_descriptor_per_regime():
     assert desc("int8") == {
         "gather_lanes": 3, "conservation": "sparse",
         "value_kinds": ("i8",), "packed_words": False,
-        "eager_foldback": True}
+        "eager_foldback": True, "gossip": None}
     assert desc("int4_packed")["packed_words"] is True
     assert desc("int8_delta_idx")["gather_lanes"] == 3
+    assert desc("gossip_ring")["gossip"] == "ring"
+    assert desc("gossip_ring")["eager_foldback"] is False
     dd = desc("dense")
     assert dd["conservation"] == "dense" and dd["gather_lanes"] == 0
 

@@ -201,6 +201,89 @@ def test_with_world_retiles_per_worker_leaves_only():
 
 
 # --------------------------------------------------------------------- #
+# gossip round state (compression.gossip) across W-changes
+# --------------------------------------------------------------------- #
+
+def _gossip_state(world, T=8, seed=0, age=None, clock=None, forced=None):
+    """Flat-engine memory carrying the gossip round state: clock /
+    forced are replicated per-worker scalars (leading [world] axis), the
+    age vector is a replicated [world]-long view, and the in-flight
+    inbox is additive mass."""
+    rng = np.random.RandomState(seed)
+    age = np.asarray(np.arange(world) if age is None else age, np.int32)
+    mem = {
+        "momentums_c": rng.randn(world, T).astype(np.float32),
+        "velocities_c": rng.randn(world, T).astype(np.float32),
+        "sent_bits": np.stack([pack_bits(np.zeros(T, bool))] * world),
+        "gossip_inbox": rng.randn(world, T).astype(np.float32),
+        "gossip_clock": np.asarray([7] * world if clock is None
+                                   else clock, np.int32),
+        "gossip_age": np.tile(age, (world, 1)),
+        "gossip_forced": np.asarray([2] * world if forced is None
+                                    else forced, np.int32),
+    }
+    return _worker_state(world).replace(memory=mem)
+
+
+def test_gossip_merge_takes_max_staleness():
+    """4 -> 2 merge: a merged worker's view is as stale as its stalest
+    parent; the clock / forced counters merge by max; the in-flight
+    inbox rides the additive path (group-summed, total conserved)."""
+    logs = []
+    s = _gossip_state(4, age=[0, 3, 1, 2], clock=[6, 7, 7, 5],
+                      forced=[2, 5, 2, 2])
+    out = elastic.reshard_state(s, _topo(4), _topo(2), log=logs.append)
+    mem = out.memory
+    assert np.asarray(mem["gossip_age"]).shape == (2, 2)
+    np.testing.assert_array_equal(mem["gossip_age"], [[3, 2], [3, 2]])
+    np.testing.assert_array_equal(mem["gossip_clock"], [7, 7])
+    np.testing.assert_array_equal(mem["gossip_forced"], [5, 5])
+    old = np.asarray(s.memory["gossip_inbox"], np.float64)
+    new = np.asarray(mem["gossip_inbox"], np.float64)
+    np.testing.assert_allclose(new[0], old[0] + old[1], rtol=1e-6)
+    np.testing.assert_allclose(new[1], old[2] + old[3], rtol=1e-6)
+    np.testing.assert_allclose(new.sum(), old.sum(), rtol=1e-5)
+    assert any("gossip round state" in l for l in logs)
+
+
+def test_gossip_split_inherits_age():
+    """2 -> 4 split: every child inherits its parent's staleness view
+    and the replicated counters bitwise; the inbox follows the split
+    rule (child c%k==0 inherits, siblings start empty)."""
+    s = _gossip_state(2, age=[3, 1])
+    out = elastic.reshard_state(s, _topo(2), _topo(4),
+                                log=lambda *_: None)
+    mem = out.memory
+    np.testing.assert_array_equal(mem["gossip_age"],
+                                  np.tile([3, 3, 1, 1], (4, 1)))
+    np.testing.assert_array_equal(mem["gossip_clock"], [7] * 4)
+    np.testing.assert_array_equal(mem["gossip_forced"], [2] * 4)
+    old = np.asarray(s.memory["gossip_inbox"])
+    new = np.asarray(mem["gossip_inbox"])
+    np.testing.assert_array_equal(new[0], old[0])
+    np.testing.assert_array_equal(new[2], old[1])
+    assert (new[1] == 0).all() and (new[3] == 0).all()
+
+
+def test_gossip_collapse_broadcasts_max():
+    """4 -> 3 (non-divisible): worker/data alignment is lost, so every
+    child's view starts at the global max age — conservative: the next
+    breach check can only over-trigger a full sync, never miss one."""
+    s = _gossip_state(4, age=[0, 3, 1, 2])
+    out = elastic.reshard_state(s, _topo(4), _topo(3),
+                                log=lambda *_: None)
+    mem = out.memory
+    np.testing.assert_array_equal(mem["gossip_age"],
+                                  np.full((3, 3), 3, np.int32))
+    np.testing.assert_array_equal(mem["gossip_clock"], [7] * 3)
+    inbox = np.asarray(mem["gossip_inbox"], np.float64)
+    np.testing.assert_allclose(
+        inbox[0], np.asarray(s.memory["gossip_inbox"],
+                             np.float64).sum(0), rtol=1e-5)
+    assert (inbox[1:] == 0).all()
+
+
+# --------------------------------------------------------------------- #
 # batch geometry + fail-fast batch slicing
 # --------------------------------------------------------------------- #
 

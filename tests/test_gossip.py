@@ -1,0 +1,457 @@
+"""Gossip sparse exchange with in-graph bounded staleness (ISSUE 20,
+dgc_tpu.compression.gossip).
+
+Covers the schedule algebra (config validation, neighborhood symmetry,
+mixing-column mass conservation, the traced/NumPy twin agreement), the
+engine-level gossip exchange against a full NumPy error-feedback oracle
+over real multi-round runs (ring + hypercube, with and without an
+injected ``droplink`` fault), the step-exact staleness-breach ->
+forced-full-sync drill, the fleet ``w_staleness`` lane on the full
+train step, and the elastic gossip-state reshard. The 2-process gloo
+gossip run lives in tests/test_multiprocess.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dgc_tpu import (DGCCompressor, DGCSGDMemory, DistributedOptimizer,
+                     dgc_sgd)
+from dgc_tpu.compression import gossip, planner
+from dgc_tpu.compression.flat import FlatDGCEngine
+from dgc_tpu.ops import kernels
+from dgc_tpu.resilience import faults
+from dgc_tpu.utils.compat import shard_map
+from dgc_tpu.utils.pytree import named_flatten
+
+W = 8
+
+
+# --------------------------------------------------------------------- #
+# schedule units                                                         #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_make_config_validation():
+    cfg = gossip.make_config("ring", W)
+    assert cfg.sync_every == gossip.default_sync_every(W) == 4
+    assert cfg.max_staleness == gossip.default_max_staleness(W) == 8
+    with pytest.raises(ValueError, match="unknown gossip topology"):
+        gossip.make_config("mesh", W)
+    with pytest.raises(ValueError, match="world >= 2"):
+        gossip.make_config("ring", 1)
+    with pytest.raises(ValueError, match="power-of-two"):
+        gossip.make_config("hcube", 6)
+    gossip.make_config("ring", 6)       # non-pow2 ring is fine
+    with pytest.raises(ValueError, match="below sync_every"):
+        gossip.make_config("ring", W, sync_every=4, max_staleness=3)
+
+
+@pytest.mark.fast
+def test_neighborhoods_symmetric_and_covering():
+    for topo in gossip.TOPOLOGIES:
+        cfg = gossip.make_config(topo, W)
+        seen = {w: set() for w in range(W)}
+        for clock in range(W):
+            for w in range(W):
+                outs = gossip.out_neighbors(cfg, clock, w)
+                assert w not in outs
+                seen[w].update(outs)
+                # symmetric: in-neighborhood == out-neighborhood
+                for p in outs:
+                    assert w in gossip.out_neighbors(cfg, clock, p)
+        # the rotation reaches every other worker eventually
+        for w in range(W):
+            assert seen[w] == set(range(W)) - {w}
+    # hcube matching is an involution every round
+    cfg = gossip.make_config("hcube", W)
+    for clock in range(W):
+        for w in range(W):
+            (p,) = gossip.out_neighbors(cfg, clock, w)
+            assert gossip.out_neighbors(cfg, clock, p) == (w,)
+
+
+@pytest.mark.fast
+def test_mixing_columns_sum_to_one():
+    # sum over receivers of each sender's weight == 1 every round: the
+    # gossip mixing matrix is column-stochastic -> signed mass conserved
+    for topo in gossip.TOPOLOGIES:
+        cfg = gossip.make_config(topo, W)
+        for clock in range(2 * W):
+            mix = np.stack([gossip.recv_weights_np(cfg, clock, r)
+                            for r in range(W)])
+            np.testing.assert_allclose(mix.sum(axis=0), 1.0, atol=1e-7)
+
+
+@pytest.mark.fast
+def test_round_state_np_schedule():
+    cfg = gossip.make_config("ring", W, sync_every=4, max_staleness=8)
+    age = np.zeros((W,), np.int32)
+    for clock in range(9):
+        full, forced, age = gossip.round_state_np(cfg, clock, age)
+        assert full == (clock % 4 == 0)
+        assert not forced                   # no fault: breaches never fire
+        want = 0 if clock % 4 == 0 else clock % 4
+        np.testing.assert_array_equal(age, want)
+
+
+@pytest.mark.fast
+def test_traced_round_state_matches_numpy():
+    rng = np.random.RandomState(0)
+    for topo in gossip.TOPOLOGIES:
+        cfg = gossip.make_config(topo, W, sync_every=3, max_staleness=5)
+        for clock in range(7):
+            age = rng.randint(0, 5, W).astype(np.int32)
+            dropped = (rng.rand(W) < 0.3)
+            for d in (None, dropped):
+                f_np, fo_np, a_np = gossip.round_state_np(
+                    cfg, clock, age, d)
+                f_t, fo_t, a_t = gossip.round_state(
+                    cfg, jnp.asarray(clock, jnp.int32), jnp.asarray(age),
+                    None if d is None else jnp.asarray(d))
+                assert bool(f_t) == f_np and bool(fo_t) == fo_np
+                np.testing.assert_array_equal(np.asarray(a_t), a_np)
+                for w in range(W):
+                    rw_np = gossip.row_weights_np(cfg, clock, w, f_np, d)
+                    rw_t = gossip.row_weights(
+                        cfg, jnp.asarray(clock, jnp.int32),
+                        jnp.asarray(w, jnp.int32), f_t,
+                        None if d is None else jnp.asarray(d))
+                    np.testing.assert_allclose(np.asarray(rw_t), rw_np,
+                                               atol=1e-7)
+
+
+# --------------------------------------------------------------------- #
+# planner: gossip regimes are a valid, opt-in plan family                #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_gossip_plan_is_opt_in():
+    # default candidate sweeps never pick gossip; forcing the candidate
+    # yields a plan carrying the validated schedule config in its key
+    assert not any(r.startswith("gossip")
+                   for r in planner.REGIMES)
+    geoms = [planner.BucketGeom(numel=4096, payload=205, rows=16,
+                                index_bits=12.0)]
+    plain = planner.plan_buckets(geoms, fabric="32x25GbE", world=W)
+    assert plain.gossip is None
+    for topo in gossip.TOPOLOGIES:
+        plan = planner.plan_buckets(geoms, fabric="32x25GbE", world=W,
+                                    candidates=("gossip_" + topo,))
+        assert plan.gossip is not None
+        assert plan.gossip.topology == topo
+        assert plan.key()[-1] == plan.gossip
+        assert plan.verify_descriptor()["gossip"] == topo
+    with pytest.raises(ValueError, match="power-of-two"):
+        planner.plan_buckets(geoms, fabric="32x25GbE", world=6,
+                             candidates=("gossip_hcube",))
+
+
+# --------------------------------------------------------------------- #
+# engine: the gossip exchange vs the NumPy oracle                        #
+# --------------------------------------------------------------------- #
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "conv1": {"kernel": jnp.asarray(rng.randn(3, 3, 4, 8), jnp.float32)},
+        "conv2": {"kernel": jnp.asarray(rng.randn(3, 3, 8, 8), jnp.float32)},
+        "dense": {"kernel": jnp.asarray(rng.randn(32, 10), jnp.float32),
+                  "bias": jnp.asarray(rng.randn(10), jnp.float32)},
+    }
+
+
+def _engine(topology="ring", sync_every=4, max_staleness=8):
+    params = _params()
+    named, _ = named_flatten(params)
+    comp = DGCCompressor(0.05, memory=DGCSGDMemory(momentum=0.9),
+                         sample_ratio=1.0)
+    comp.initialize((n, p) for n, p in named.items() if p.ndim > 1)
+    dist = DistributedOptimizer(dgc_sgd(0.1, momentum=0.9), comp,
+                                world_size=W)
+    layout, engine = dist.make_flat(params)
+    plan = planner.plan_buckets(
+        [planner.bucket_geometry(b) for b in engine.buckets],
+        fabric="32x25GbE", world=W, candidates=("gossip_" + topology,),
+        gossip_sync_every=sync_every, gossip_max_staleness=max_staleness)
+    return comp, layout, FlatDGCEngine(comp, layout, plan=plan)
+
+
+def _grads(layout, rng):
+    g = np.zeros((W, layout.total), np.float32)
+    for n in layout.names:
+        o, s = layout.offsets[n], layout.sizes[n]
+        g[:, o:o + s] = rng.randn(W, s)
+    return g
+
+
+def _exchange_fn(engine, mesh):
+    def worker(fg, mem, key):
+        fg = fg[0]
+        mem = jax.tree.map(lambda x: x[0], mem)
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        out, mem = engine.exchange(fg, mem, key, "data", W, op="average")
+        return out[None], jax.tree.map(lambda x: x[None], mem)
+
+    return jax.jit(shard_map(
+        worker, mesh=mesh,
+        in_specs=(P("data"), P("data"), P()),
+        out_specs=(P("data"), P("data")), check_vma=False))
+
+
+def _init_mem(engine):
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (W,) + x.shape),
+        engine.init_memory())
+
+
+def _run_oracle(mesh, topology, steps=6, droplink=None):
+    """Drive the gossip engine ``steps`` rounds against the full NumPy
+    oracle: velocity recurrence (inbox fold included), wire output,
+    inbox contents, ages, clock, forced counter, and global signed +
+    absolute mass conservation. ``droplink`` is a per-round [W] bool
+    predicate (round -> dropped vector) mirroring the armed fault."""
+    comp, layout, engine = _engine(topology)
+    T = engine.T
+    cfg = engine._gossip
+    f = _exchange_fn(engine, mesh)
+    mem = _init_mem(engine)
+    rng = np.random.RandomState(3)
+
+    mom = comp.memory.momentum
+    v_np = np.zeros((W, T), np.float32)
+    m_np = np.zeros((W, T), np.float32)
+    inbox_np = np.zeros((W, T), np.float32)
+    keep_prev = np.ones((W, T), np.float32)
+    age_np = np.zeros((W,), np.int32)
+    forced_total = 0
+    saw_gossip = saw_full = False
+
+    for step in range(steps):
+        g = _grads(layout, rng)
+        out, mem = f(jnp.asarray(g), mem, jax.random.PRNGKey(step))
+        out0 = np.asarray(out)[0]
+        dropped = droplink(step) if droplink is not None else None
+        bits = np.asarray(mem["sent_bits"])
+        keep_new = np.stack([
+            np.asarray(kernels.keep_from_bits(jnp.asarray(bits[w]), T))
+            for w in range(W)])
+        sent_new = 1.0 - keep_new
+        if dropped is not None:
+            # the fault voids the dropped sender's transmit record: its
+            # mass must stay home in full
+            for p in np.nonzero(dropped)[0]:
+                np.testing.assert_array_equal(keep_new[p], 1.0)
+
+        full, forced, age_np = gossip.round_state_np(
+            cfg, step, age_np, dropped)
+        forced_total += int(forced)
+        # oracle recurrence: previous round's deferred mask first, THEN
+        # the inbox fold (received mass can never be wiped by the
+        # receiver's own record)
+        m_np = mom * (m_np * keep_prev) + g[:, :T]
+        v_np = v_np * keep_prev + m_np + inbox_np
+
+        vc = np.asarray(mem["velocities_c"])
+        np.testing.assert_allclose(vc, v_np, rtol=1e-5, atol=1e-5)
+
+        transmitted = v_np * sent_new
+        if full:
+            saw_full = True
+            live = (np.ones(W) if dropped is None
+                    else 1.0 - dropped.astype(np.float32))
+            np.testing.assert_allclose(
+                out0[:T], (transmitted * live[:, None]).sum(0) / W,
+                rtol=1e-5, atol=1e-5)
+            inbox_np = np.zeros((W, T), np.float32)
+        else:
+            saw_gossip = True
+            assert np.allclose(out0[:T], 0.0)
+            inbox_np = np.stack([
+                gossip.recv_weights_np(cfg, step, w) @ transmitted
+                for w in range(W)])
+        np.testing.assert_allclose(np.asarray(mem["gossip_inbox"]),
+                                   inbox_np, rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(
+            np.asarray(mem["gossip_age"])[0], age_np)
+        assert int(np.asarray(mem["gossip_clock"])[0]) == step + 1
+        assert int(np.asarray(mem["gossip_forced"])[0]) == forced_total
+        # the bound holds by construction, fault or no fault
+        assert int(age_np.max()) <= cfg.max_staleness
+        # global ABSOLUTE mass: everything accumulated is either kept
+        # (residual) or on the wire — nothing invented, nothing lost
+        raw = np.abs(v_np.astype(np.float64)).sum()
+        keep_mass = np.abs((v_np * keep_new).astype(np.float64)).sum()
+        tx_mass = np.abs(transmitted.astype(np.float64)).sum()
+        assert abs((keep_mass + tx_mass) - raw) <= 1e-6 * max(raw, 1e-12)
+        keep_prev = keep_new
+    assert saw_gossip and saw_full   # the run exercised both round kinds
+    return engine, mem, forced_total
+
+
+@pytest.mark.parametrize("topology", gossip.TOPOLOGIES)
+def test_gossip_mass_conservation_oracle(mesh8, topology):
+    """>= 3 real gossip rounds (plus full-sync rounds) at W=8 against
+    the NumPy oracle: velocities, wire, inbox, ages, clock, and global
+    mass conservation to 1e-6 relative."""
+    _, _, forced = _run_oracle(mesh8, topology, steps=6)
+    assert forced == 0                   # no fault, no forced syncs
+
+
+def test_gossip_droplink_mass_survives(mesh8, monkeypatch):
+    """A ``droplink`` round: the dropped worker's contribution is
+    suppressed on every receiver AND voided from its own transmit
+    record, so the mass-conservation oracle holds straight through the
+    fault — and the unset fault stays byte-free (covered by the
+    gossip-off contract)."""
+    monkeypatch.setenv(faults.ENV, "droplink:peer=3@1-1")
+
+    def droplink(rnd):
+        if rnd == 1:
+            d = np.zeros((W,), bool)
+            d[3] = True
+            return d
+        return None
+
+    _, mem, forced = _run_oracle(mesh8, "ring", steps=4,
+                                 droplink=droplink)
+    assert forced == 0       # one dropped round never breaches ms=8
+    # the dropped round fed worker 3's receivers zero: their inboxes at
+    # round 1 excluded its mass (already asserted inside the oracle via
+    # transmitted[3] == 0); by round 4 everything is flowing again
+    assert int(np.asarray(mem["gossip_clock"])[0]) == 4
+
+
+def test_staleness_breach_forces_sync_step_exact(mesh8, monkeypatch):
+    """The degradation ladder, pinned step-exact: a droplink on worker 3
+    over gossip rounds 1..5 with ``max_staleness == sync_every == 4``
+    forces full syncs at exactly rounds 5 (still dropped: age would hit
+    5 > 4) and 6 (first live round: the stale view flushes and resets),
+    then the schedule resumes — and no age ever exceeds the bound."""
+    monkeypatch.setenv(faults.ENV, "droplink:peer=3@1-5")
+    comp, layout, engine = _engine("ring", sync_every=4, max_staleness=4)
+    cfg = engine._gossip
+    f = _exchange_fn(engine, mesh8)
+    mem = _init_mem(engine)
+    rng = np.random.RandomState(5)
+
+    want_forced = [0, 0, 0, 0, 0, 1, 2, 2]
+    want_age3 = [0, 1, 2, 3, 4, 4, 0, 1]    # worker 3's age, clamped at 4
+    for step in range(8):
+        g = _grads(layout, rng)
+        out, mem = f(jnp.asarray(g), mem, jax.random.PRNGKey(step))
+        age = np.asarray(mem["gossip_age"])[0]
+        assert int(np.asarray(mem["gossip_forced"])[0]) \
+            == want_forced[step], step
+        assert int(age[3]) == want_age3[step], step
+        assert int(age.max()) <= cfg.max_staleness
+        # forced and scheduled rounds apply globally (nonzero sparse
+        # out); pure gossip rounds keep the params untouched
+        is_full = (step % 4 == 0) or step in (5, 6)
+        sparse_out = np.abs(np.asarray(out)[0][:engine.T]).sum()
+        assert (sparse_out > 0) == is_full, step
+
+
+def test_gossip_memory_roundtrip_keeps_round_state(mesh8):
+    """Checkpoint semantics at the engine level: the canonical
+    memory_full view folds the in-flight inbox into velocities (mass-
+    conserving), and a state-dict roundtrip preserves clock/age/forced
+    bitwise with a zeroed inbox."""
+    _, mem, _ = _run_oracle(mesh8, "ring", steps=3)
+    comp, layout, engine = _engine("ring")
+    mem0 = jax.tree.map(lambda x: jnp.asarray(x[0]), mem)
+    full = engine.memory_full(mem0)
+    keep = np.asarray(kernels.keep_from_bits(mem0["sent_bits"], engine.T))
+    want_v = (np.asarray(mem0["velocities_c"]) * keep
+              + np.asarray(mem0["gossip_inbox"]))
+    np.testing.assert_allclose(np.asarray(full["velocities"])[:engine.T],
+                               want_v, rtol=1e-6, atol=1e-6)
+    saved = engine.memory_state_dict(mem0)
+    restored = engine.load_memory_state_dict(mem0, saved)
+    for k in ("gossip_clock", "gossip_age", "gossip_forced"):
+        np.testing.assert_array_equal(np.asarray(restored[k]),
+                                      np.asarray(mem0[k]))
+    np.testing.assert_array_equal(np.asarray(restored["gossip_inbox"]), 0)
+    # and the restored velocities carry the folded inbox mass
+    np.testing.assert_allclose(
+        np.asarray(restored["velocities_c"]), want_v, rtol=1e-6, atol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# full train step: the w_staleness lane rides the fleet gather           #
+# --------------------------------------------------------------------- #
+
+def test_step_fleet_staleness_lane(mesh8):
+    """The fleet step under a gossip plan: w_staleness is a real
+    per-worker column tracking the gossip ages, max_staleness_seen /
+    gossip_forced_syncs ride along, and a non-gossip fleet build keeps
+    the same schema with constant-zero values."""
+    from dgc_tpu.analysis.suite import build_fixture
+
+    g_plan = planner.plan_buckets([], fabric="32x25GbE", world=W,
+                                  candidates=("gossip_ring",),
+                                  gossip_sync_every=4)
+    state, step, setup, (images, labels, key) = build_fixture(
+        mesh8, donate=False, telemetry=True, fleet=True, plan=g_plan)
+    sh = NamedSharding(mesh8, P(tuple(mesh8.axis_names)))
+    clock = jax.device_put(np.full((W,), 10.0, np.float32), sh)
+
+    ages = []
+    for i in range(3):
+        state, metrics = step(state, images, labels, key, clock)
+        flt = metrics["fleet"]
+        col = np.asarray(flt["w_staleness"])
+        assert col.shape == (W,)
+        ages.append(col)
+        assert float(flt["max_staleness_seen"]) == col.max()
+        assert float(flt["gossip_forced_syncs"]) == 0.0
+    # round 0 is the warm full sync (ages 0); rounds 1..2 are gossip
+    # rounds, every worker's age ticking up in lockstep
+    np.testing.assert_allclose(ages[0], 0.0)
+    np.testing.assert_allclose(ages[1], 1.0)
+    np.testing.assert_allclose(ages[2], 2.0)
+
+    # gossip off: identical schema, constant-zero gossip lanes
+    state_p, step_p, _, (im, lb, k) = build_fixture(
+        mesh8, donate=False, telemetry=True, fleet=True)
+    _, metrics_p = step_p(state_p, im, lb, k, clock)
+    np.testing.assert_allclose(
+        np.asarray(metrics_p["fleet"]["w_staleness"]), 0.0)
+    assert float(metrics_p["fleet"]["max_staleness_seen"]) == 0.0
+    assert float(metrics_p["fleet"]["gossip_forced_syncs"]) == 0.0
+
+
+# --------------------------------------------------------------------- #
+# faults: droplink parsing                                               #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.fast
+def test_droplink_parsing():
+    p = faults.plan("droplink:peer=3@2-5")
+    assert p.droplink_peer == 3 and p.droplink_window == (2, 5)
+    assert faults.plan("droplink:peer=1").droplink_window == (0, None)
+    assert faults.plan("droplink:peer=1@7").droplink_window == (7, None)
+    with pytest.raises(ValueError, match="peer"):
+        faults.plan("droplink@2-5")
+    # unarmed: the injector is Python-static None (zero HLO)
+    assert faults.gossip_dropped(W, jnp.zeros((), jnp.int32)) is None \
+        or faults.plan().droplink_peer is None
+
+
+@pytest.mark.fast
+def test_droplink_window_counts_gossip_rounds():
+    import os
+    old = os.environ.get(faults.ENV)
+    os.environ[faults.ENV] = "droplink:peer=2@3-4"
+    try:
+        for clock, inside in ((2, False), (3, True), (4, True), (5, False)):
+            d = np.asarray(faults.gossip_dropped(
+                W, jnp.asarray(clock, jnp.int32)))
+            assert d[2] == inside and d.sum() == int(inside)
+    finally:
+        if old is None:
+            os.environ.pop(faults.ENV, None)
+        else:
+            os.environ[faults.ENV] = old

@@ -159,6 +159,64 @@ def test_kill_and_resume_bitwise_memory(tmp_path):
                                    "num_local_workers": 1}
 
 
+def test_gossip_two_process_save_resume(tmp_path):
+    """Gossip drill over a real process boundary (docs/RESILIENCE.md
+    §Gossip exchange): run the fleet train step under a ``gossip_ring``
+    plan across 2 gloo processes with ``droplink:peer=3@1-5`` armed on
+    BOTH (the injector is traced into the shared program). The staleness
+    ladder must replay the step-exact single-process arithmetic — worker
+    3's age climbs to the bound, forced full-syncs fire at exactly
+    clocks 5 and 6 — the ``w_staleness`` lane and forced-sync counter
+    must reach the fleet sink, and a mid-drill collective checkpoint
+    must round-trip the gossip clock state BITWISE: the resumed run's
+    losses and final gossip fingerprint match the uninterrupted run
+    exactly."""
+    worker = os.path.join(os.path.dirname(__file__), "gossip_worker.py")
+    fault = {i: {"DGC_FAULTS": "droplink:peer=3@1-5"} for i in (0, 1)}
+    run = _run_pair(worker, tmp_path, "run", extra_env=fault)
+    res = _run_pair(worker, tmp_path, "resume", extra_env=fault)
+
+    # replicated verdicts: both processes observe identical lanes
+    for key in ("losses", "w_staleness", "forced", "max_seen"):
+        assert run[0][key] == run[1][key], key
+    # the step-exact degradation ladder (tests/test_gossip.py::
+    # test_staleness_breach_forces_sync_step_exact, now cross-process)
+    assert run[0]["forced"] == [0, 0, 0, 0, 0, 1, 2, 2]
+    age3 = [col[3] for col in run[0]["w_staleness"]]
+    assert age3 == [0, 1, 2, 3, 4, 4, 0, 1]
+    assert run[0]["max_seen"] == [0, 1, 2, 3, 4, 4, 0, 1]
+    # the bound holds for every worker at every step
+    assert max(x for col in run[0]["w_staleness"] for x in col) <= 4
+
+    # bitwise save/resume of the gossip clock state, per process shard
+    for p in (0, 1):
+        assert res[p]["start"] == 5
+        assert res[p]["gossip_restored"] == run[p]["gossip_saved"]
+        # the resumed trajectory IS the uninterrupted one
+        assert res[p]["losses"] == run[p]["losses"][5:]
+        assert res[p]["forced"] == run[p]["forced"][5:]
+        assert res[p]["w_staleness"] == run[p]["w_staleness"][5:]
+        assert res[p]["gossip_final"] == run[p]["gossip_final"]
+        assert res[p]["mem_final"] == run[p]["mem_final"]
+
+    # the staleness gauges reached the per-host sink shards
+    from dgc_tpu.telemetry import fleet, monitor
+
+    view = fleet.load_view(str(tmp_path / "gossiprun"))
+    assert sorted(view.hosts) == ["host0", "host1"]
+    assert view.world == 8
+    series = dict(fleet.worker_series(view, "w_staleness"))
+    assert [s[3] for s in (series[i] for i in range(8))] \
+        == [0, 1, 2, 3, 4, 4, 0, 1]
+
+    snap = monitor.collect(str(tmp_path / "gossiprun"))
+    om = monitor.render_openmetrics(snap)
+    assert "dgc_worker_staleness" in om
+    assert "dgc_gossip_forced_syncs" in om
+    status = monitor.render_status(snap)
+    assert "GOSSIP:" in status and "FORCED SYNCS 2" in status
+
+
 def _run_elastic_phase(tmp_path, phase, world, *extra):
     """One single-process launch of tests/elastic_worker.py at a fake
     world size; returns the parsed RESULT dict."""

@@ -387,18 +387,60 @@ def main():
             floor_ms=_ak("floor_ms", 1.0))
         printr(f"[adaptive] {adaptive_cfg}")
 
+    # decentralized gossip exchange (configs/gossip.py, docs/RESILIENCE.md
+    # §Gossip exchange) — a plan-time OPT-IN: the gossip regime families
+    # are never in the default candidate sweep (bounded staleness is a
+    # consistency-model change), so the opt-in adds them and the planner
+    # still falls back to the synchronous exchange where it models cheaper.
+    gcfg = configs.train.get("gossip", None)
+    gossip_on = bool(gcfg and gcfg.get("enabled", False))
+    gossip_family = None
+    gossip_plan = None       # the standing plan threaded into rebuilds
+    gossip_kw = {}
+    if gossip_on:
+        if not configs.train.dgc:
+            raise SystemExit("gossip decentralizes the sparse DGC wire "
+                             "(configs with train.dgc = True)")
+        gossip_family = "gossip_" + str(gcfg.get("topology", "ring"))
+
+        def _gk(key):
+            v = gcfg.get(key, None)
+            return None if v is None else int(v)
+        gossip_kw = dict(gossip_sync_every=_gk("sync_every"),
+                         gossip_max_staleness=_gk("max_staleness"))
+
     flat_setup = make_flat_setup(variables, dist)
     if autotune_on:
         from dgc_tpu.compression.autotune import Autotuner
+        from dgc_tpu.compression.planner import REGIMES
         autotuner = Autotuner(
             world=world,
             fabric_out=os.path.join(configs.train.save_path, "fabric.json"),
-            min_points=int(atcfg.get("min_points", 2)) if atcfg else 2)
+            min_points=int(atcfg.get("min_points", 2)) if atcfg else 2,
+            candidates=(REGIMES + (gossip_family,) if gossip_on
+                        else REGIMES),
+            **gossip_kw)
         flat_setup = make_flat_setup(
             variables, dist, plan=autotuner.plan_for(flat_setup.engine))
         printr(f"[autotune] fabric {autotuner.fabric.name} "
                f"({autotuner.fabric.gbps:.3g} GB/s) -> "
                f"plan {list(flat_setup.engine.regimes)}")
+    elif gossip_on:
+        from dgc_tpu.compression.planner import plan_engine
+        # kept for every warm-up rebuild: make_flat_setup re-fits it to
+        # the fresh bucket geometry (Plan.replan preserves the gossip
+        # candidates + schedule knobs)
+        gossip_plan = plan_engine(flat_setup.engine, world=world,
+                                  candidates=(gossip_family,), **gossip_kw)
+        flat_setup = make_flat_setup(variables, dist, plan=gossip_plan)
+        eng_plan = flat_setup.engine.plan
+        if eng_plan is not None and eng_plan.gossip is not None:
+            printr(f"[gossip] {eng_plan.gossip} -> "
+                   f"plan {list(flat_setup.engine.regimes)}")
+        else:
+            printr("[gossip] planner kept the synchronous exchange on "
+                   "this fabric (never-lose): no bucket chose "
+                   f"{gossip_family}")
     state = shard_state(make_flat_state(variables, dist, flat_setup, world,
                                         guards=guards_cfg,
                                         adaptive=adaptive_cfg),
@@ -654,7 +696,9 @@ def main():
         if rebuild:
             # ratio change => new static attrs => new engine + re-jit
             # (reference compression.py:91-107; <= warmup_epochs+1 compiles)
-            flat_setup = make_flat_setup(variables, dist)
+            # (the standing gossip plan re-fits to the fresh geometry;
+            # None when gossip is off or the autotuner owns the plan)
+            flat_setup = make_flat_setup(variables, dist, plan=gossip_plan)
             if autotuner is not None:
                 # replan against the FRESH bucket geometry under the
                 # current (possibly refit) fabric — host-side only
